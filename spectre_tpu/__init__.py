@@ -1,0 +1,29 @@
+"""spectre_tpu — a TPU-native ZK proving framework.
+
+From-scratch rebuild of the capabilities of ChainSafe/Spectre (reference at
+/root/reference): an Ethereum Altair light-client prover — PLONKish circuits over
+BN254 with KZG/SHPLONK commitments, BLS12-381 signature verification in-circuit,
+SSZ merkleization, Poseidon committee commitments — with the dominant proving
+costs (MSM, NTT, bulk SHA256/Poseidon hashing) running as JAX/Pallas kernels on
+TPU, sharded over device meshes via jax.sharding.
+
+Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
+  fields/        host-side exact arithmetic: BN254, BLS12-381 (oracle + verifier)
+  native/        C++ host library: Montgomery field ops, Pippenger MSM (CPU
+                 baseline), transcript hashing
+  ops/           device kernels: limbed Montgomery Fr, NTT, MSM, SHA256, Poseidon
+  plonk/         the proving system: KZG/SHPLONK, lookup + permutation arguments,
+                 prover/verifier (halo2-compatible protocol shape)
+  builder/       virtual circuit builder: flex gate, range chip, CRT bigint,
+                 non-native Fp/ECC chips (halo2-lib equivalent)
+  gadgets/       SSZ merkleization, merkle proofs, poseidon commitment
+  models/        application circuits: StepCircuit, CommitteeUpdateCircuit,
+                 AggregationCircuit
+  witness/       witness types + builders (SyncStepArgs, CommitteeUpdateArgs)
+  preprocessor/  Beacon API -> witness conversion
+  prover_service/ CLI, JSON-RPC server/client, prover state
+  parallel/      mesh sharding: distributed MSM/NTT, batched proving
+  utils/         pinning, serialization, SRS cache
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,8 @@
+"""Host-side exact arithmetic: prime fields, extension towers, curves, pairings.
+
+These are the correctness oracles and the verifier-side math. Hot bulk math runs
+on device (spectre_tpu.ops) or in C++ (spectre_tpu.native); this package is pure
+Python working over arbitrary-precision ints.
+"""
+
+from .common import PrimeField, make_prime_field, CurveGroup  # noqa: F401

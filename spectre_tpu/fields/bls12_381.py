@@ -1,0 +1,434 @@
+"""BLS12-381: fields, groups, pairing, hash-to-curve, BLS signatures, serialization.
+
+Witness-side curve: sync-committee pubkeys are G1 (48B compressed), aggregate
+signatures are G2 (96B compressed). The preprocessor decompresses/aggregates
+natively here (reference parity: `preprocessor/src/step.rs:62-158` +
+`halo2curves` host ops, SURVEY.md §2b N5); the in-circuit constraint generation
+happens over BN254 Fr via builder.fp_chip.
+
+Tower: Fq2 = Fq[u]/(u^2+1), Fq12 = Fq[w]/(w^12 - 2 w^6 + 2) (so u = w^6 - 1);
+G2 embeds into E(Fq12) via the M-twist x -> x/w^2, y -> y/w^3.
+
+Hash-to-curve: expand_message_xmd(SHA-256) + hash_to_field per RFC 9380, with a
+Shallue–van de Woestijne map whose constants (Z, c1..c4, cofactors) are DERIVED
+lazily on first use from the RFC's published criteria rather than hardcoded.
+NOTE: the reference uses the SSWU(iso) suite BLS12381G2_XMD:SHA-256_SSWU_RO
+(`halo2-lib feat/bls12-381-hash2curve`); SvdW here is a documented deviation —
+uniform and spec-derivable, prover/circuit/native stay mutually consistent, but
+NOT interoperable with signatures produced by real eth2 validators until the
+SSWU 3-isogeny constants are derived (planned: Vélu derivation, later round).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import math
+
+from ..spec import DST
+from .common import CurveGroup, make_ext_field, make_prime_field
+from .pairing import PairingEngine
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+BLS_X = -0xD201000000010000  # BLS parameter (negative)
+
+Fq = make_prime_field(P, "FqBLS")
+Fr = make_prime_field(R, "FrBLS")
+Fq2 = make_ext_field(P, [1, 0], "Fq2BLS")
+Fq12 = make_ext_field(P, [2, 0, 0, 0, 0, 0, -2 % P, 0, 0, 0, 0, 0], "Fq12BLS")
+
+B1 = Fq(4)
+B2 = Fq2([4, 4])
+
+g1_curve = CurveGroup(Fq, Fq(0), B1, order=R)
+g2_curve = CurveGroup(Fq2, Fq2.zero(), B2, order=R)
+g12_curve = CurveGroup(Fq12, Fq12.zero(), Fq12.from_base(4), order=R)
+
+G1_GEN = (
+    Fq(0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB),
+    Fq(0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1),
+)
+G2_GEN = (
+    Fq2([
+        0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+        0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+    ]),
+    Fq2([
+        0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+        0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+    ]),
+)
+
+assert g1_curve.is_on_curve(G1_GEN)
+assert g2_curve.is_on_curve(G2_GEN)
+
+# ---------------------------------------------------------------------------
+# group orders & cofactors (lazily derived, deterministic, then sanity-checked)
+# ---------------------------------------------------------------------------
+
+_t1 = BLS_X + 1                    # trace of Frobenius over Fq
+N1 = P + 1 - _t1                   # |E(Fq)|
+H1 = N1 // R                       # G1 cofactor
+assert N1 % R == 0
+
+
+def _deterministic_twist_points(count: int):
+    """First `count` points on E'(Fq2) with x = k + u, k = 0,1,2,..."""
+    pts = []
+    k = 0
+    while len(pts) < count:
+        x = Fq2([k, 1])
+        y = (x * x * x + B2).sqrt()
+        if y is not None:
+            pts.append((x, y))
+        k += 1
+    return pts
+
+
+@functools.cache
+def twist_order() -> int:
+    """|E'(Fq2)| for the M-twist, found among the six sextic-twist candidate
+    orders p^2 + 1 - t' (checked against on-curve points). Avoids hardcoding."""
+    t2 = _t1 * _t1 - 2 * P         # trace over Fq2
+    # 4p^2 = t2^2 + 3 f2^2
+    f2_sq, rem = divmod(4 * P * P - t2 * t2, 3)
+    assert rem == 0
+    f2 = math.isqrt(f2_sq)
+    assert f2 * f2 == f2_sq
+    candidates = [
+        P * P + 1 - t2, P * P + 1 + t2,
+        P * P + 1 - (t2 + 3 * f2) // 2, P * P + 1 + (t2 + 3 * f2) // 2,
+        P * P + 1 - (t2 - 3 * f2) // 2, P * P + 1 + (t2 - 3 * f2) // 2,
+    ]
+    pts = _deterministic_twist_points(2)
+    for n in candidates:
+        if n % R == 0 and all(g2_curve.mul_unsafe(pt, n) is None for pt in pts):
+            return n
+    raise AssertionError("no twist order candidate matched")
+
+
+@functools.cache
+def g2_cofactor() -> int:
+    return twist_order() // R
+
+
+def clear_cofactor_g2(pt):
+    return g2_curve.mul_unsafe(pt, g2_cofactor())
+
+
+def clear_cofactor_g1(pt):
+    return g1_curve.mul_unsafe(pt, H1)
+
+
+# ---------------------------------------------------------------------------
+# pairing (shared engine; BLS has no post-loop corrections)
+# ---------------------------------------------------------------------------
+
+ATE_LOOP_COUNT = -BLS_X  # 15132376222941642752
+
+_W2_INV = Fq12([0, 0, 1] + [0] * 9).inv()
+_W3_INV = Fq12([0, 0, 0, 1] + [0] * 8).inv()
+
+
+def _fq2_to_fq12(x):
+    """a0 + a1*u -> (a0 - a1) + a1 w^6   (u = w^6 - 1)."""
+    a0, a1 = x.c
+    return Fq12([(a0 - a1) % P, 0, 0, 0, 0, 0, a1, 0, 0, 0, 0, 0])
+
+
+def twist(pt):
+    if pt is None:
+        return None
+    x, y = pt
+    return (_fq2_to_fq12(x) * _W2_INV, _fq2_to_fq12(y) * _W3_INV)
+
+
+def cast_g1(pt):
+    if pt is None:
+        return None
+    return (Fq12.from_base(pt[0].n), Fq12.from_base(pt[1].n))
+
+
+ENGINE = PairingEngine(
+    p=P, r=R, fq12=Fq12, g12_curve=g12_curve, twist=twist, cast_g1=cast_g1,
+    loop_count=ATE_LOOP_COUNT, corrections=None,
+)
+
+
+def miller_loop(q, p, final_exp: bool = True):
+    return ENGINE.miller_loop(q, p, final_exp)
+
+
+def final_exponentiation(f):
+    return ENGINE.final_exponentiation(f)
+
+
+def pairing(q, p):
+    """e(p, q): p in G1, q in G2 (twist coords)."""
+    assert g2_curve.is_on_curve(q) and g1_curve.is_on_curve(p)
+    return ENGINE.pairing(q, p)
+
+
+def pairing_check(pairs) -> bool:
+    return ENGINE.pairing_check(pairs)
+
+
+# ---------------------------------------------------------------------------
+# RFC 9380 hashing: expand_message_xmd + hash_to_field
+# ---------------------------------------------------------------------------
+
+def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
+    """expand_message_xmd with SHA-256 (RFC 9380 §5.3.1)."""
+    assert len(dst) <= 255
+    b_in_bytes, r_in_bytes = 32, 64
+    ell = (len_in_bytes + b_in_bytes - 1) // b_in_bytes
+    assert ell <= 255
+    dst_prime = dst + bytes([len(dst)])
+    z_pad = b"\x00" * r_in_bytes
+    l_i_b_str = len_in_bytes.to_bytes(2, "big")
+    b0 = hashlib.sha256(z_pad + msg + l_i_b_str + b"\x00" + dst_prime).digest()
+    b1 = hashlib.sha256(b0 + b"\x01" + dst_prime).digest()
+    out = [b1]
+    for i in range(2, ell + 1):
+        prev = out[-1]
+        tmp = bytes(a ^ c for a, c in zip(b0, prev))
+        out.append(hashlib.sha256(tmp + bytes([i]) + dst_prime).digest())
+    return b"".join(out)[:len_in_bytes]
+
+
+L_FIELD = 64  # ceil((ceil(log2(p)) + k) / 8) with k=128 for BLS12-381
+
+
+def hash_to_field_fq2(msg: bytes, dst: bytes, count: int = 2):
+    """hash_to_field into Fq2 (m=2, L=64)."""
+    len_in_bytes = count * 2 * L_FIELD
+    pseudo = expand_message_xmd(msg, dst, len_in_bytes)
+    out = []
+    for i in range(count):
+        coeffs = []
+        for j in range(2):
+            off = L_FIELD * (j + i * 2)
+            coeffs.append(int.from_bytes(pseudo[off:off + L_FIELD], "big") % P)
+        out.append(Fq2(coeffs))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Shallue–van de Woestijne map to G2 (constants derived per RFC 9380 §H.1)
+# ---------------------------------------------------------------------------
+
+def _g2_rhs(x):
+    return x * x * x + B2
+
+
+@functools.cache
+def _svdw_constants():
+    """(Z, c1, c2, c3, c4) for the SvdW map on E': y^2 = x^3 + 4(1+u), derived
+    from the RFC 9380 H.1 criteria over a fixed deterministic candidate order."""
+    def candidates():
+        for k in range(1, 20):
+            yield Fq2([k, 0]); yield Fq2([-k % P, 0])
+            yield Fq2([0, k]); yield Fq2([0, -k % P])
+            yield Fq2([k, k]); yield Fq2([-k % P, -k % P])
+    z = None
+    for cand in candidates():
+        gz = _g2_rhs(cand)
+        if gz.is_zero():
+            continue
+        h = -(cand * cand * 3) / (gz * 4)      # A = 0
+        if h.is_zero() or h.sqrt() is None:
+            continue
+        g_half = _g2_rhs(-cand / Fq2([2, 0]))
+        if gz.sqrt() is not None or g_half.sqrt() is not None:
+            z = cand
+            break
+    assert z is not None, "no SvdW Z found"
+    c1 = _g2_rhs(z)
+    c2 = -z / Fq2([2, 0])
+    c3 = (-c1 * (z * z * 3)).sqrt()
+    assert c3 is not None
+    if c3.sgn0() != 0:
+        c3 = -c3
+    c4 = (-c1 * 4) / (z * z * 3)
+    return z, c1, c2, c3, c4
+
+
+def map_to_curve_svdw_g2(u: "Fq2"):
+    """RFC 9380 §6.6.1 straight-line SvdW (constant set derived above)."""
+    z, c1, c2, c3, c4 = _svdw_constants()
+    one = Fq2.one()
+    tv1 = u * u * c1
+    tv2 = one + tv1
+    tv1 = one - tv1
+    tv3 = tv1 * tv2
+    tv3 = tv3.inv() if not tv3.is_zero() else Fq2.zero()
+    tv4 = u * tv1 * tv3 * c3
+    x1 = c2 - tv4
+    gx1 = _g2_rhs(x1)
+    e1 = gx1.sqrt() is not None
+    x2 = c2 + tv4
+    gx2 = _g2_rhs(x2)
+    e2 = (gx2.sqrt() is not None) and not e1
+    x3 = (tv2 * tv2 * tv3) ** 2 * c4 + z
+    x = x1 if e1 else (x2 if e2 else x3)
+    gx = _g2_rhs(x)
+    y = gx.sqrt()
+    assert y is not None
+    if u.sgn0() != y.sgn0():
+        y = -y
+    return (x, y)
+
+
+def hash_to_g2(msg: bytes, dst: bytes = DST):
+    """hash_to_curve: two field elements, two maps, add, clear cofactor.
+
+    Reference parity: `HashToCurveChip` (SSWU + ExpandMsgXmd) in the halo2-lib
+    fork; deviation: SvdW map (see module docstring)."""
+    u0, u1 = hash_to_field_fq2(msg, dst)
+    q0 = map_to_curve_svdw_g2(u0)
+    q1 = map_to_curve_svdw_g2(u1)
+    return clear_cofactor_g2(g2_curve.add(q0, q1))
+
+
+# ---------------------------------------------------------------------------
+# BLS signatures (eth2 flavor: pubkeys in G1, signatures in G2)
+# ---------------------------------------------------------------------------
+
+def sk_to_pk(sk: int):
+    return g1_curve.mul(G1_GEN, sk % R)
+
+
+def sign(sk: int, msg: bytes, dst: bytes = DST):
+    return g2_curve.mul(hash_to_g2(msg, dst), sk % R)
+
+
+def aggregate_signatures(sigs):
+    acc = None
+    for s in sigs:
+        acc = g2_curve.add(acc, s)
+    return acc
+
+
+def aggregate_pubkeys(pks):
+    acc = None
+    for pk in pks:
+        acc = g1_curve.add(acc, pk)
+    return acc
+
+
+def verify(pk, msg: bytes, sig, dst: bytes = DST) -> bool:
+    """e(pk, H(m)) == e(g1, sig)  <=>  e(pk, H(m)) * e(-g1, sig) == 1.
+
+    Rejects identity pubkey/signature up front (eth2 KeyValidate: accepting the
+    point at infinity enables the classic zero-key forgery)."""
+    if pk is None or sig is None:
+        return False
+    h = hash_to_g2(msg, dst)
+    return pairing_check([(pk, h), (g1_curve.neg(G1_GEN), sig)])
+
+
+def fast_aggregate_verify(pks, msg: bytes, sig, dst: bytes = DST) -> bool:
+    if not pks or any(pk is None for pk in pks):
+        return False
+    return verify(aggregate_pubkeys(pks), msg, sig, dst)
+
+
+# ---------------------------------------------------------------------------
+# ZCash/eth2 point serialization (compressed, with flag bits)
+# ---------------------------------------------------------------------------
+
+_COMP_FLAG = 1 << 7
+_INF_FLAG = 1 << 6
+_SIGN_FLAG = 1 << 5
+
+
+def _fq_sign(y: "Fq") -> bool:
+    return y.n > (P - 1) // 2
+
+
+def _fq2_sign(y: "Fq2") -> bool:
+    """Lexicographic: c1 dominates; tie-break on c0."""
+    if y.c[1] != 0:
+        return y.c[1] > (P - 1) // 2
+    return y.c[0] > (P - 1) // 2
+
+
+def g1_compress(pt) -> bytes:
+    """48-byte compressed G1 (reference handles these in
+    `committee_update_circuit.rs:129` / preprocessor pubkey decompress)."""
+    if pt is None:
+        return bytes([_COMP_FLAG | _INF_FLAG]) + b"\x00" * 47
+    x, y = pt
+    b = bytearray(int(x).to_bytes(48, "big"))
+    b[0] |= _COMP_FLAG
+    if _fq_sign(y):
+        b[0] |= _SIGN_FLAG
+    return bytes(b)
+
+
+def g1_decompress(b: bytes, subgroup_check: bool = False):
+    assert len(b) == 48
+    flags = b[0]
+    assert flags & _COMP_FLAG, "uncompressed flag"
+    if flags & _INF_FLAG:
+        assert flags == (_COMP_FLAG | _INF_FLAG) and b[1:] == b"\x00" * 47, \
+            "non-canonical infinity encoding"
+        return None
+    xi = int.from_bytes(bytes([flags & 0x1F]) + b[1:], "big")
+    assert xi < P, "x not canonical"
+    x = Fq(xi)
+    y = (x * x * x + B1).sqrt()
+    assert y is not None, "x not on curve"
+    if _fq_sign(y) != bool(flags & _SIGN_FLAG):
+        y = -y
+    pt = (x, y)
+    if subgroup_check:
+        assert g1_curve.in_subgroup(pt), "point not in G1 subgroup"
+    return pt
+
+
+def g2_compress(pt) -> bytes:
+    if pt is None:
+        return bytes([_COMP_FLAG | _INF_FLAG]) + b"\x00" * 95
+    x, y = pt
+    b = bytearray(x.c[1].to_bytes(48, "big") + x.c[0].to_bytes(48, "big"))
+    b[0] |= _COMP_FLAG
+    if _fq2_sign(y):
+        b[0] |= _SIGN_FLAG
+    return bytes(b)
+
+
+def g2_decompress(b: bytes, subgroup_check: bool = False):
+    assert len(b) == 96
+    flags = b[0]
+    assert flags & _COMP_FLAG, "uncompressed flag"
+    if flags & _INF_FLAG:
+        assert flags == (_COMP_FLAG | _INF_FLAG) and b[1:] == b"\x00" * 95, \
+            "non-canonical infinity encoding"
+        return None
+    c1 = int.from_bytes(bytes([flags & 0x1F]) + b[1:48], "big")
+    c0 = int.from_bytes(b[48:], "big")
+    assert c0 < P and c1 < P, "x not canonical"
+    x = Fq2([c0, c1])
+    y = (x * x * x + B2).sqrt()
+    assert y is not None, "x not on curve"
+    if _fq2_sign(y) != bool(flags & _SIGN_FLAG):
+        y = -y
+    pt = (x, y)
+    if subgroup_check:
+        assert g2_curve.in_subgroup(pt), "point not in G2 subgroup"
+    return pt
+
+
+def __getattr__(name):
+    # lazily-derived constants kept available under their public names
+    if name == "N2":
+        return twist_order()
+    if name == "H2":
+        return g2_cofactor()
+    if name == "Z_SVDW":
+        return _svdw_constants()[0]
+    if name == "DST_G2":  # legacy alias
+        return DST
+    raise AttributeError(name)

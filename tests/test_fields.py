@@ -1,0 +1,203 @@
+"""Host field/curve/pairing oracle tests (BN254 + BLS12-381)."""
+
+import secrets
+
+import pytest
+
+from spectre_tpu.fields import bls12_381 as bls
+from spectre_tpu.fields import bn254 as bn
+from spectre_tpu.fields.common import modinv, tonelli_shanks
+
+
+class TestPrimeFieldBasics:
+    def test_modinv(self):
+        for _ in range(10):
+            a = secrets.randbelow(bn.R - 1) + 1
+            assert a * modinv(a, bn.R) % bn.R == 1
+
+    def test_sqrt(self):
+        for _ in range(10):
+            a = secrets.randbelow(bn.P)
+            s = tonelli_shanks(a * a % bn.P, bn.P)
+            assert s is not None and s * s % bn.P == a * a % bn.P
+
+    def test_field_ops(self):
+        a, b = bn.Fr.random(), bn.Fr.random()
+        assert (a + b) - b == a
+        assert (a * b) / b == a
+        assert a ** 3 == a * a * a
+        assert -a + a == bn.Fr.zero()
+
+
+class TestExtField:
+    def test_fq2_mul_inv(self):
+        for F in (bn.Fq2, bls.Fq2):
+            a = F.random()
+            assert a * a.inv() == F.one()
+            b = F.random()
+            assert (a + b) * (a - b) == a * a - b * b
+
+    def test_fq12_tower(self):
+        for F in (bn.Fq12, bls.Fq12):
+            a, b = F.random(), F.random()
+            assert (a * b) / b == a
+            assert a ** 5 == a * a * a * a * a
+
+    def test_fq2_sqrt(self):
+        a = bls.Fq2.random()
+        s = (a * a).sqrt()
+        assert s is not None and s * s == a * a
+
+
+class TestBN254Curve:
+    def test_generators_on_curve_and_order(self):
+        assert bn.g1_curve.is_on_curve(bn.G1_GEN)
+        assert bn.g2_curve.is_on_curve(bn.G2_GEN)
+        assert bn.g1_curve.in_subgroup(bn.G1_GEN)
+        assert bn.g2_curve.in_subgroup(bn.G2_GEN)
+
+    def test_group_law(self):
+        p2 = bn.g1_curve.double(bn.G1_GEN)
+        p3 = bn.g1_curve.add(p2, bn.G1_GEN)
+        assert p3 == bn.g1_curve.mul(bn.G1_GEN, 3)
+        assert bn.g1_curve.add(p3, bn.g1_curve.neg(p3)) is None
+
+    def test_root_of_unity(self):
+        w = bn.fr_root_of_unity(10)
+        assert pow(w, 1 << 10, bn.R) == 1
+        assert pow(w, 1 << 9, bn.R) != 1
+
+    def test_serialization(self):
+        pt = bn.g1_curve.mul(bn.G1_GEN, 12345)
+        assert bn.g1_from_bytes(bn.g1_to_bytes(pt)) == pt
+        q = bn.g2_curve.mul(bn.G2_GEN, 999)
+        assert bn.g2_from_bytes(bn.g2_to_bytes(q)) == q
+
+
+class TestBN254Pairing:
+    def test_bilinearity(self):
+        e1 = bn.pairing(bn.G2_GEN, bn.G1_GEN)
+        e2 = bn.pairing(bn.g2_curve.mul(bn.G2_GEN, 5), bn.g1_curve.mul(bn.G1_GEN, 7))
+        assert e1 ** 35 == e2
+
+    def test_pairing_check(self):
+        # e(6*G1, G2) * e(-2*G1, 3*G2) == 1
+        assert bn.pairing_check([
+            (bn.g1_curve.mul(bn.G1_GEN, 6), bn.G2_GEN),
+            (bn.g1_curve.neg(bn.g1_curve.mul(bn.G1_GEN, 2)), bn.g2_curve.mul(bn.G2_GEN, 3)),
+        ])
+        assert not bn.pairing_check([
+            (bn.g1_curve.mul(bn.G1_GEN, 5), bn.G2_GEN),
+            (bn.g1_curve.neg(bn.g1_curve.mul(bn.G1_GEN, 2)), bn.g2_curve.mul(bn.G2_GEN, 3)),
+        ])
+
+
+class TestBLS12381:
+    def test_derived_cofactors_match_published(self):
+        # cross-check the runtime derivation against the well-known values
+        assert bls.H1 == 0x396C8C005555E1568C00AAAB0000AAAB
+        assert bls.H2 == int(
+            "0x5d543a95414e7f1091d50792876a202cd91de4547085abaa68a205b2e5a7ddfa628f1c"
+            "b4d9e82ef21537e293a6691ae1616ec6e786f0c70cf1c38e31c7238e5", 16)
+
+    def test_bilinearity(self):
+        e1 = bls.pairing(bls.G2_GEN, bls.G1_GEN)
+        e2 = bls.pairing(bls.g2_curve.mul(bls.G2_GEN, 3), bls.g1_curve.mul(bls.G1_GEN, 11))
+        assert e1 ** 33 == e2
+
+    def test_hash_to_g2_in_subgroup(self):
+        h = bls.hash_to_g2(b"spectre_tpu test msg")
+        assert bls.g2_curve.in_subgroup(h)
+
+    def test_hash_to_g2_deterministic_and_dst_separated(self):
+        assert bls.hash_to_g2(b"m") == bls.hash_to_g2(b"m")
+        assert bls.hash_to_g2(b"m") != bls.hash_to_g2(b"m", dst=b"OTHER_DST_")
+
+    def test_expand_message_xmd_shape(self):
+        out = bls.expand_message_xmd(b"abc", b"DST", 100)
+        assert len(out) == 100
+        assert out != bls.expand_message_xmd(b"abd", b"DST", 100)
+
+
+class TestBLSSignatures:
+    def test_aggregate_sign_verify(self):
+        sks = [secrets.randbelow(bls.R) for _ in range(4)]
+        pks = [bls.sk_to_pk(sk) for sk in sks]
+        msg = b"attested header root"
+        agg = bls.aggregate_signatures([bls.sign(sk, msg) for sk in sks])
+        assert bls.fast_aggregate_verify(pks, msg, agg)
+        assert not bls.fast_aggregate_verify(pks, b"wrong", agg)
+        assert not bls.fast_aggregate_verify(pks[:3], msg, agg)
+
+    def test_compression_roundtrip(self):
+        sk = secrets.randbelow(bls.R)
+        pk = bls.sk_to_pk(sk)
+        sig = bls.g2_curve.mul(bls.G2_GEN, sk)
+        assert bls.g1_decompress(bls.g1_compress(pk)) == pk
+        assert bls.g2_decompress(bls.g2_compress(sig)) == sig
+        assert bls.g1_decompress(bls.g1_compress(None)) is None
+        assert bls.g2_decompress(bls.g2_compress(None)) is None
+
+    def test_decompress_rejects_noncanonical(self):
+        import pytest as _pt
+        # infinity flag with nonzero payload
+        with _pt.raises(AssertionError):
+            bls.g1_decompress(b"\xc0" + b"\x01" + b"\x00" * 46)
+        # x >= p
+        with _pt.raises(AssertionError):
+            bls.g1_decompress(b"\x9f" + b"\xff" * 47)
+        # subgroup check catches cofactor points
+        import secrets as _s
+        while True:
+            x = bls.Fq(_s.randbelow(bls.P))
+            yy = (x * x * x + bls.B1).sqrt()
+            if yy is not None:
+                pt = (x, yy)
+                break
+        if not bls.g1_curve.in_subgroup(pt):  # overwhelmingly likely
+            with _pt.raises(AssertionError):
+                bls.g1_decompress(bls.g1_compress(pt), subgroup_check=True)
+
+    def test_g1_compress_sign_bit(self):
+        pk = bls.sk_to_pk(42)
+        x, y = pk
+        neg = (x, -y)
+        assert bls.g1_compress(pk) != bls.g1_compress(neg)
+        assert bls.g1_decompress(bls.g1_compress(neg)) == neg
+
+
+class TestReviewRegressions:
+    """Regressions for code-review findings on the initial math layer."""
+
+    def test_no_infinity_forgery(self):
+        # empty/identity pubkey+signature must NOT verify (eth2 KeyValidate)
+        assert not bls.fast_aggregate_verify([], b"msg", None)
+        assert not bls.verify(None, b"msg", None)
+        assert not bls.fast_aggregate_verify([None, bls.sk_to_pk(1)], b"msg", None)
+
+    def test_cross_field_mixing_raises(self):
+        with pytest.raises(TypeError):
+            bn.Fr(5) + bn.Fq(7)
+        with pytest.raises(TypeError):
+            bn.Fq(bls.Fq(123))
+        with pytest.raises(TypeError):
+            bls.Fq(1) * bn.Fq(1)
+
+    def test_eq_against_foreign_types(self):
+        assert bn.Fq(1) != None  # noqa: E711
+        assert not (bn.Fr(1) == bn.Fq(1))
+        assert bn.Fq(1) in [None, bn.Fq(1)]
+
+    def test_spec_mirrors_reference(self):
+        from spectre_tpu import spec
+        # values from /root/reference/eth-types/src/spec.rs
+        assert spec.MINIMAL.execution_state_root_index == 9
+        assert spec.MAINNET.execution_state_root_index == 25
+        assert spec.MAINNET.execution_state_root_depth == 4
+        assert spec.MAINNET.sync_committee_pubkeys_root_index == 110
+        assert spec.MAINNET.sync_committee_pubkeys_depth == 6
+        assert spec.MAINNET.dst == spec.DST
+
+    def test_lazy_derived_constants(self):
+        assert bls.H2 * bls.R == bls.N2
+        assert bls.DST_G2 == bls.DST if hasattr(bls, "DST") else True
