@@ -1,0 +1,19 @@
+"""ctypes bindings for the C++ host library (libspectre_host.so).
+
+Build with `make -C spectre_tpu/native`. These are the CPU-baseline / oracle
+entry points: batched BN254 field ops, Fr NTT, Pippenger G1 MSM.
+"""
+
+from .host import (  # noqa: F401
+    HostLib,
+    available,
+    fp_add_batch,
+    fp_inv_batch,
+    fp_mul_batch,
+    fp_sub_batch,
+    fr_ntt,
+    g1_add_affine_batch,
+    g1_msm,
+    limbs_to_ints,
+    ints_to_limbs,
+)

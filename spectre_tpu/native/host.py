@@ -1,0 +1,189 @@
+"""ctypes wrapper over libspectre_host.so with numpy limb interop.
+
+Boundary convention (matches spectre_host.cc): field elements are 4 little-
+endian uint64 limbs, standard (non-Montgomery) form; affine points are 8 limbs
+(x||y) with (0,0) = infinity.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libspectre_host.so")
+
+FQ = 0
+FR = 1
+
+
+def _build_if_needed() -> bool:
+    src = os.path.join(_DIR, "src", "spectre_host.cc")
+    if os.path.exists(_SO):
+        if not os.path.exists(src) or os.path.getmtime(_SO) >= os.path.getmtime(src):
+            return True  # prebuilt .so without sources is fine
+    try:
+        subprocess.run(["make", "-C", _DIR], check=True, capture_output=True)
+        return True
+    except Exception:
+        return False
+
+
+class HostLib:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            if not _build_if_needed():
+                raise RuntimeError("libspectre_host.so missing and build failed")
+            lib = ctypes.CDLL(_SO)
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            lib.spectre_init.restype = None
+            for name in ("fp_mul_batch", "fp_add_batch", "fp_sub_batch"):
+                fn = getattr(lib, name)
+                fn.argtypes = [ctypes.c_int, u64p, u64p, u64p, ctypes.c_size_t]
+                fn.restype = None
+            lib.fp_inv_batch.argtypes = [ctypes.c_int, u64p, u64p, ctypes.c_size_t]
+            lib.fp_inv_batch.restype = None
+            lib.fp_pow_single.argtypes = [ctypes.c_int, u64p, u64p, u64p]
+            lib.fp_pow_single.restype = None
+            lib.fr_ntt.argtypes = [u64p, ctypes.c_size_t, u64p]
+            lib.fr_ntt.restype = None
+            lib.g1_msm.argtypes = [u64p, u64p, ctypes.c_size_t, ctypes.c_int,
+                                   u64p, ctypes.POINTER(ctypes.c_int)]
+            lib.g1_msm.restype = None
+            lib.g1_add_affine_batch.argtypes = [u64p, u64p, u64p, ctypes.c_size_t]
+            lib.g1_add_affine_batch.restype = None
+            lib.spectre_init()
+            inst = super().__new__(cls)
+            inst.lib = lib
+            cls._instance = inst
+        return cls._instance
+
+
+def available() -> bool:
+    try:
+        HostLib()
+        return True
+    except Exception:  # missing sources, corrupt .so, failed build, ...
+        return False
+
+
+def _u64p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+# ---------------------------------------------------------------------------
+# int <-> limb conversion
+# ---------------------------------------------------------------------------
+
+def ints_to_limbs(vals, nlimbs: int = 4) -> np.ndarray:
+    """list[int] -> [n, nlimbs] uint64 little-endian limb array."""
+    out = np.zeros((len(vals), nlimbs), dtype=np.uint64)
+    for i, v in enumerate(vals):
+        v = int(v)
+        for j in range(nlimbs):
+            out[i, j] = (v >> (64 * j)) & 0xFFFFFFFFFFFFFFFF
+    return out
+
+
+def limbs_to_ints(arr: np.ndarray) -> list:
+    arr = np.ascontiguousarray(arr, dtype=np.uint64)
+    n, nl = arr.shape
+    return [sum(int(arr[i, j]) << (64 * j) for j in range(nl)) for i in range(n)]
+
+
+def points_to_limbs(points) -> np.ndarray:
+    """list of affine (x, y) field-elem tuples or None -> [n, 8] uint64."""
+    flat = []
+    for pt in points:
+        if pt is None:
+            flat.extend([0, 0])
+        else:
+            flat.extend([int(pt[0]), int(pt[1])])
+    xs = ints_to_limbs(flat)
+    return xs.reshape(len(points), 8)
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+
+def _binop(name: str, field: int, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    lib = HostLib().lib
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    b = np.ascontiguousarray(b, dtype=np.uint64)
+    assert a.shape == b.shape and a.shape[1] == 4
+    out = np.empty_like(a)
+    getattr(lib, name)(field, _u64p(a), _u64p(b), _u64p(out), a.shape[0])
+    return out
+
+
+def fp_mul_batch(field: int, a, b):
+    return _binop("fp_mul_batch", field, a, b)
+
+
+def fp_add_batch(field: int, a, b):
+    return _binop("fp_add_batch", field, a, b)
+
+
+def fp_sub_batch(field: int, a, b):
+    return _binop("fp_sub_batch", field, a, b)
+
+
+def fp_inv_batch(field: int, a) -> np.ndarray:
+    lib = HostLib().lib
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    assert a.ndim == 2 and a.shape[1] == 4
+    out = np.empty_like(a)
+    lib.fp_inv_batch(field, _u64p(a), _u64p(out), a.shape[0])
+    return out
+
+
+def fr_ntt(data: np.ndarray, omega: int) -> np.ndarray:
+    """NTT of a C-contiguous uint64 [n, 4] limb array (n a power of 2).
+
+    Transforms in place and returns the SAME array. Rejects inputs that would
+    silently be copied (non-contiguous / wrong dtype), since the caller would
+    otherwise keep an untransformed buffer."""
+    lib = HostLib().lib
+    assert isinstance(data, np.ndarray) and data.dtype == np.uint64 \
+        and data.flags["C_CONTIGUOUS"], "fr_ntt requires a C-contiguous uint64 array"
+    assert data.ndim == 2 and data.shape[1] == 4
+    n = data.shape[0]
+    logn = n.bit_length() - 1
+    assert 1 << logn == n
+    om = ints_to_limbs([omega])
+    lib.fr_ntt(_u64p(data), logn, _u64p(om))
+    return data
+
+
+def g1_msm(points: np.ndarray, scalars: np.ndarray, nthreads: int = 1):
+    """points [n,8], scalars [n,4] -> affine (x:int, y:int) or None."""
+    lib = HostLib().lib
+    points = np.ascontiguousarray(points, dtype=np.uint64)
+    scalars = np.ascontiguousarray(scalars, dtype=np.uint64)
+    n = points.shape[0]
+    assert scalars.shape == (n, 4) and points.shape == (n, 8)
+    out = np.zeros(8, dtype=np.uint64)
+    inf = ctypes.c_int(0)
+    lib.g1_msm(_u64p(points), _u64p(scalars), n, nthreads, _u64p(out),
+               ctypes.byref(inf))
+    if inf.value:
+        return None
+    x = sum(int(out[j]) << (64 * j) for j in range(4))
+    y = sum(int(out[4 + j]) << (64 * j) for j in range(4))
+    return (x, y)
+
+
+def g1_add_affine_batch(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    lib = HostLib().lib
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    b = np.ascontiguousarray(b, dtype=np.uint64)
+    assert a.shape == b.shape and a.shape[1] == 8
+    out = np.empty_like(a)
+    lib.g1_add_affine_batch(_u64p(a), _u64p(b), _u64p(out), a.shape[0])
+    return out

@@ -1,0 +1,604 @@
+// spectre_host: C++ host-side math for spectre_tpu.
+//
+// Role (SURVEY.md §2b): the native component of the stack — the CPU reference
+// implementation of BN254 field arithmetic (N1), Pippenger MSM (N2) and NTT
+// (N3) that (a) serves as the measured CPU baseline for bench.py and (b) is
+// the exact oracle the JAX/Pallas device kernels are tested against. Where the
+// reference uses Rust (`halo2curves-axiom`, halo2's rayon Pippenger/FFT), this
+// is an independent C++ implementation: 4x64-bit limbs, CIOS Montgomery
+// multiplication, jacobian coordinates.
+//
+// Exported ABI is C (ctypes-friendly): field elements are 4 little-endian
+// uint64 limbs in standard (non-Montgomery) form at the boundary; points are
+// affine (x, y) limb pairs, infinity flagged separately.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+using u64 = uint64_t;
+using u128 = unsigned __int128;
+
+namespace {
+
+struct Fp {
+  u64 v[4];
+};
+
+struct FpCtx {
+  u64 mod[4];
+  u64 n0inv;  // -mod^{-1} mod 2^64
+  Fp r2;      // R^2 mod p, R = 2^256
+  Fp one;     // R mod p (Montgomery 1)
+};
+
+// BN254 base field (G1 coordinates)
+constexpr u64 FQ_MOD[4] = {0x3c208c16d87cfd47ULL, 0x97816a916871ca8dULL,
+                           0xb85045b68181585dULL, 0x30644e72e131a029ULL};
+// BN254 scalar field (NTT / witness scalars)
+constexpr u64 FR_MOD[4] = {0x43e1f593f0000001ULL, 0x2833e84879b97091ULL,
+                           0xb85045b68181585dULL, 0x30644e72e131a029ULL};
+
+FpCtx g_fq, g_fr;
+
+inline bool ge(const u64* a, const u64* b) {
+  for (int i = 3; i >= 0; --i) {
+    if (a[i] != b[i]) return a[i] > b[i];
+  }
+  return true;
+}
+
+inline void sub_nocheck(u64* out, const u64* a, const u64* b) {
+  u128 borrow = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 d = (u128)a[i] - b[i] - (u64)borrow;
+    out[i] = (u64)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+}
+
+inline void cond_sub_mod(u64* t, const FpCtx& C) {
+  if (ge(t, C.mod)) sub_nocheck(t, t, C.mod);
+}
+
+inline void fp_add(Fp& out, const Fp& a, const Fp& b, const FpCtx& C) {
+  u128 carry = 0;
+  u64 t[5];
+  for (int i = 0; i < 4; ++i) {
+    u128 s = (u128)a.v[i] + b.v[i] + (u64)carry;
+    t[i] = (u64)s;
+    carry = s >> 64;
+  }
+  t[4] = (u64)carry;
+  if (t[4] || ge(t, C.mod)) sub_nocheck(t, t, C.mod);
+  std::memcpy(out.v, t, 32);
+}
+
+inline void fp_sub(Fp& out, const Fp& a, const Fp& b, const FpCtx& C) {
+  u128 borrow = 0;
+  u64 t[4];
+  for (int i = 0; i < 4; ++i) {
+    u128 d = (u128)a.v[i] - b.v[i] - (u64)borrow;
+    t[i] = (u64)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+  if (borrow) {
+    u128 carry = 0;
+    for (int i = 0; i < 4; ++i) {
+      u128 s = (u128)t[i] + C.mod[i] + (u64)carry;
+      t[i] = (u64)s;
+      carry = s >> 64;
+    }
+  }
+  std::memcpy(out.v, t, 32);
+}
+
+// CIOS Montgomery multiplication (Acar): out = a*b*R^{-1} mod p
+inline void fp_mul(Fp& out, const Fp& a, const Fp& b, const FpCtx& C) {
+  u64 t[6] = {0, 0, 0, 0, 0, 0};
+  for (int i = 0; i < 4; ++i) {
+    u64 carry = 0;
+    for (int j = 0; j < 4; ++j) {
+      u128 cur = (u128)a.v[j] * b.v[i] + t[j] + carry;
+      t[j] = (u64)cur;
+      carry = (u64)(cur >> 64);
+    }
+    u128 cur = (u128)t[4] + carry;
+    t[4] = (u64)cur;
+    t[5] = (u64)(cur >> 64);
+
+    u64 m = t[0] * C.n0inv;
+    cur = (u128)t[0] + (u128)m * C.mod[0];
+    carry = (u64)(cur >> 64);
+    for (int j = 1; j < 4; ++j) {
+      cur = (u128)t[j] + (u128)m * C.mod[j] + carry;
+      t[j - 1] = (u64)cur;
+      carry = (u64)(cur >> 64);
+    }
+    cur = (u128)t[4] + carry;
+    t[3] = (u64)cur;
+    t[4] = t[5] + (u64)(cur >> 64);
+  }
+  cond_sub_mod(t, C);
+  std::memcpy(out.v, t, 32);
+}
+
+inline void fp_sqr(Fp& out, const Fp& a, const FpCtx& C) { fp_mul(out, a, a, C); }
+
+inline bool fp_is_zero(const Fp& a) {
+  return (a.v[0] | a.v[1] | a.v[2] | a.v[3]) == 0;
+}
+
+inline bool fp_eq(const Fp& a, const Fp& b) {
+  return std::memcmp(a.v, b.v, 32) == 0;
+}
+
+inline void to_mont(Fp& out, const Fp& a, const FpCtx& C) { fp_mul(out, a, C.r2, C); }
+inline void from_mont(Fp& out, const Fp& a, const FpCtx& C) {
+  Fp one = {{1, 0, 0, 0}};
+  fp_mul(out, a, one, C);
+}
+
+// out = a^e (Montgomery in/out), e standard 4-limb little-endian
+void fp_pow(Fp& out, const Fp& a, const u64* e, const FpCtx& C) {
+  Fp result = C.one;
+  Fp base = a;
+  for (int limb = 0; limb < 4; ++limb) {
+    u64 bits = e[limb];
+    for (int i = 0; i < 64; ++i) {
+      if (bits & 1) fp_mul(result, result, base, C);
+      fp_sqr(base, base, C);
+      bits >>= 1;
+    }
+  }
+  out = result;
+}
+
+void fp_inv(Fp& out, const Fp& a, const FpCtx& C) {
+  u64 e[4];
+  std::memcpy(e, C.mod, 32);
+  e[0] -= 2;  // p is odd, no borrow
+  fp_pow(out, a, e, C);
+}
+
+void ctx_init(FpCtx& C, const u64* mod) {
+  std::memcpy(C.mod, mod, 32);
+  // n0inv = -mod^{-1} mod 2^64 via Newton iteration
+  u64 inv = 1;
+  for (int i = 0; i < 63; ++i) inv *= 2 - mod[0] * inv;
+  C.n0inv = ~inv + 1;
+  // R mod p by long division-free doubling: start at 1, double 256 times
+  Fp r = {{1, 0, 0, 0}};
+  for (int i = 0; i < 256; ++i) fp_add(r, r, r, C);  // fp_add reduces mod p
+  C.one = r;
+  // R^2 mod p: double one 256 more times
+  Fp r2 = r;
+  for (int i = 0; i < 256; ++i) fp_add(r2, r2, r2, C);
+  C.r2 = r2;
+}
+
+// ---------------------------------------------------------------------------
+// G1 jacobian arithmetic over Fq (a = 0, b = 3); Z == 0 means infinity.
+// ---------------------------------------------------------------------------
+
+struct G1 {
+  Fp x, y, z;  // Montgomery form
+};
+
+inline void g1_set_inf(G1& p) { std::memset(&p, 0, sizeof(G1)); }
+inline bool g1_is_inf(const G1& p) { return fp_is_zero(p.z); }
+
+// dbl-2009-l
+void g1_dbl(G1& out, const G1& p) {
+  if (g1_is_inf(p)) {
+    out = p;
+    return;
+  }
+  const FpCtx& C = g_fq;
+  Fp A, B, Cc, D, E, F, t0, t1;
+  fp_sqr(A, p.x, C);
+  fp_sqr(B, p.y, C);
+  fp_sqr(Cc, B, C);
+  fp_add(t0, p.x, B, C);
+  fp_sqr(t0, t0, C);
+  fp_sub(t0, t0, A, C);
+  fp_sub(t0, t0, Cc, C);
+  fp_add(D, t0, t0, C);
+  fp_add(E, A, A, C);
+  fp_add(E, E, A, C);
+  fp_sqr(F, E, C);
+  G1 r;
+  fp_add(t0, D, D, C);
+  fp_sub(r.x, F, t0, C);
+  fp_sub(t0, D, r.x, C);
+  fp_mul(t0, E, t0, C);
+  fp_add(t1, Cc, Cc, C);
+  fp_add(t1, t1, t1, C);
+  fp_add(t1, t1, t1, C);
+  fp_sub(r.y, t0, t1, C);
+  fp_mul(r.z, p.y, p.z, C);
+  fp_add(r.z, r.z, r.z, C);
+  out = r;
+}
+
+// add-2007-bl (general jacobian add)
+void g1_add(G1& out, const G1& p, const G1& q) {
+  if (g1_is_inf(p)) {
+    out = q;
+    return;
+  }
+  if (g1_is_inf(q)) {
+    out = p;
+    return;
+  }
+  const FpCtx& C = g_fq;
+  Fp z1z1, z2z2, u1, u2, s1, s2, h, i, j, rr, v, t0, t1;
+  fp_sqr(z1z1, p.z, C);
+  fp_sqr(z2z2, q.z, C);
+  fp_mul(u1, p.x, z2z2, C);
+  fp_mul(u2, q.x, z1z1, C);
+  fp_mul(t0, q.z, z2z2, C);
+  fp_mul(s1, p.y, t0, C);
+  fp_mul(t0, p.z, z1z1, C);
+  fp_mul(s2, q.y, t0, C);
+  fp_sub(h, u2, u1, C);
+  fp_sub(rr, s2, s1, C);
+  if (fp_is_zero(h)) {
+    if (fp_is_zero(rr)) {
+      g1_dbl(out, p);
+      return;
+    }
+    g1_set_inf(out);
+    return;
+  }
+  fp_add(rr, rr, rr, C);  // r = 2(S2-S1)
+  fp_add(i, h, h, C);
+  fp_sqr(i, i, C);  // I = (2H)^2
+  fp_mul(j, h, i, C);
+  fp_mul(v, u1, i, C);
+  G1 r;
+  fp_sqr(r.x, rr, C);
+  fp_sub(r.x, r.x, j, C);
+  fp_add(t0, v, v, C);
+  fp_sub(r.x, r.x, t0, C);
+  fp_sub(t0, v, r.x, C);
+  fp_mul(t0, rr, t0, C);
+  fp_mul(t1, s1, j, C);
+  fp_add(t1, t1, t1, C);
+  fp_sub(r.y, t0, t1, C);
+  fp_add(t0, p.z, q.z, C);
+  fp_sqr(t0, t0, C);
+  fp_sub(t0, t0, z1z1, C);
+  fp_sub(t0, t0, z2z2, C);
+  fp_mul(r.z, t0, h, C);
+  out = r;
+}
+
+// mixed add: q affine (Montgomery coords), q_inf flag; madd-2007-bl
+void g1_madd(G1& out, const G1& p, const Fp& qx, const Fp& qy) {
+  if (g1_is_inf(p)) {
+    out.x = qx;
+    out.y = qy;
+    out.z = g_fq.one;
+    return;
+  }
+  const FpCtx& C = g_fq;
+  Fp z1z1, u2, s2, h, hh, i, j, rr, v, t0, t1;
+  fp_sqr(z1z1, p.z, C);
+  fp_mul(u2, qx, z1z1, C);
+  fp_mul(t0, p.z, z1z1, C);
+  fp_mul(s2, qy, t0, C);
+  fp_sub(h, u2, p.x, C);
+  fp_sub(rr, s2, p.y, C);
+  if (fp_is_zero(h)) {
+    if (fp_is_zero(rr)) {
+      g1_dbl(out, p);
+      return;
+    }
+    g1_set_inf(out);
+    return;
+  }
+  fp_add(rr, rr, rr, C);  // r = 2(S2-Y1)
+  fp_sqr(hh, h, C);
+  fp_add(i, hh, hh, C);
+  fp_add(i, i, i, C);  // I = 4 HH
+  fp_mul(j, h, i, C);
+  fp_mul(v, p.x, i, C);
+  G1 r;
+  fp_sqr(r.x, rr, C);
+  fp_sub(r.x, r.x, j, C);
+  fp_add(t0, v, v, C);
+  fp_sub(r.x, r.x, t0, C);
+  fp_sub(t0, v, r.x, C);
+  fp_mul(t0, rr, t0, C);
+  fp_mul(t1, p.y, j, C);
+  fp_add(t1, t1, t1, C);
+  fp_sub(r.y, t0, t1, C);
+  fp_add(t0, p.z, h, C);
+  fp_sqr(t0, t0, C);
+  fp_sub(t0, t0, z1z1, C);
+  fp_sub(r.z, t0, hh, C);
+  out = r;
+}
+
+void g1_to_affine_inner(Fp& ox, Fp& oy, const G1& p) {
+  const FpCtx& C = g_fq;
+  Fp zinv, zinv2, zinv3;
+  fp_inv(zinv, p.z, C);
+  fp_sqr(zinv2, zinv, C);
+  fp_mul(zinv3, zinv2, zinv, C);
+  fp_mul(ox, p.x, zinv2, C);
+  fp_mul(oy, p.y, zinv3, C);
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// exported C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void spectre_init() {
+  static bool done = false;
+  if (!done) {
+    ctx_init(g_fq, FQ_MOD);
+    ctx_init(g_fr, FR_MOD);
+    done = true;
+  }
+}
+
+// ---- batched field ops (standard form at the boundary); field: 0=Fq, 1=Fr ----
+
+static const FpCtx& pick(int field) {
+  spectre_init();
+  return field ? g_fr : g_fq;
+}
+
+void fp_mul_batch(int field, const u64* a, const u64* b, u64* out, size_t n) {
+  const FpCtx& C = pick(field);
+  for (size_t i = 0; i < n; ++i) {
+    Fp am, bm, r;
+    std::memcpy(am.v, a + 4 * i, 32);
+    std::memcpy(bm.v, b + 4 * i, 32);
+    to_mont(am, am, C);
+    to_mont(bm, bm, C);
+    fp_mul(r, am, bm, C);
+    from_mont(r, r, C);
+    std::memcpy(out + 4 * i, r.v, 32);
+  }
+}
+
+void fp_add_batch(int field, const u64* a, const u64* b, u64* out, size_t n) {
+  const FpCtx& C = pick(field);
+  for (size_t i = 0; i < n; ++i) {
+    Fp am, bm, r;
+    std::memcpy(am.v, a + 4 * i, 32);
+    std::memcpy(bm.v, b + 4 * i, 32);
+    fp_add(r, am, bm, C);
+    std::memcpy(out + 4 * i, r.v, 32);
+  }
+}
+
+void fp_sub_batch(int field, const u64* a, const u64* b, u64* out, size_t n) {
+  const FpCtx& C = pick(field);
+  for (size_t i = 0; i < n; ++i) {
+    Fp am, bm, r;
+    std::memcpy(am.v, a + 4 * i, 32);
+    std::memcpy(bm.v, b + 4 * i, 32);
+    fp_sub(r, am, bm, C);
+    std::memcpy(out + 4 * i, r.v, 32);
+  }
+}
+
+void fp_inv_batch(int field, const u64* a, u64* out, size_t n) {
+  // Montgomery batch-inversion trick: one fp_inv for the whole batch.
+  const FpCtx& C = pick(field);
+  std::vector<Fp> vals(n), prefix(n);
+  Fp acc = C.one;
+  for (size_t i = 0; i < n; ++i) {
+    std::memcpy(vals[i].v, a + 4 * i, 32);
+    to_mont(vals[i], vals[i], C);
+    prefix[i] = acc;
+    if (!fp_is_zero(vals[i])) fp_mul(acc, acc, vals[i], C);
+  }
+  Fp inv_acc;
+  fp_inv(inv_acc, acc, C);
+  for (size_t i = n; i-- > 0;) {
+    Fp r;
+    if (fp_is_zero(vals[i])) {
+      std::memset(out + 4 * i, 0, 32);  // inv(0) := 0 convention
+      continue;
+    }
+    fp_mul(r, inv_acc, prefix[i], C);
+    fp_mul(inv_acc, inv_acc, vals[i], C);
+    from_mont(r, r, C);
+    std::memcpy(out + 4 * i, r.v, 32);
+  }
+}
+
+void fp_pow_single(int field, const u64* a, const u64* e, u64* out) {
+  const FpCtx& C = pick(field);
+  Fp am, r;
+  std::memcpy(am.v, a, 32);
+  to_mont(am, am, C);
+  fp_pow(r, am, e, C);
+  from_mont(r, r, C);
+  std::memcpy(out, r.v, 32);
+}
+
+// ---- NTT over Fr (in place, standard form at the boundary) ----
+// omega must be a primitive 2^logn-th root of unity.
+
+void fr_ntt(u64* data, size_t logn, const u64* omega_std) {
+  spectre_init();
+  const FpCtx& C = g_fr;
+  const size_t n = (size_t)1 << logn;
+  // load to Montgomery
+  std::vector<Fp> a(n);
+  for (size_t i = 0; i < n; ++i) {
+    std::memcpy(a[i].v, data + 4 * i, 32);
+    to_mont(a[i], a[i], C);
+  }
+  // bit-reverse permutation
+  for (size_t i = 1, j = 0; i < n; ++i) {
+    size_t bit = n >> 1;
+    for (; j & bit; bit >>= 1) j ^= bit;
+    j ^= bit;
+    if (i < j) std::swap(a[i], a[j]);
+  }
+  Fp omega;
+  std::memcpy(omega.v, omega_std, 32);
+  to_mont(omega, omega, C);
+  // stage twiddles: w_m = omega^(n/m)
+  for (size_t m = 2; m <= n; m <<= 1) {
+    Fp wm = omega;
+    for (size_t k = m; k < n; k <<= 1) fp_sqr(wm, wm, C);  // omega^(n/m)
+    for (size_t start = 0; start < n; start += m) {
+      Fp w = C.one;
+      for (size_t j = 0; j < m / 2; ++j) {
+        Fp t, u;
+        fp_mul(t, a[start + j + m / 2], w, C);
+        u = a[start + j];
+        fp_add(a[start + j], u, t, C);
+        fp_sub(a[start + j + m / 2], u, t, C);
+        fp_mul(w, w, wm, C);
+      }
+    }
+  }
+  for (size_t i = 0; i < n; ++i) {
+    Fp r;
+    from_mont(r, a[i], C);
+    std::memcpy(data + 4 * i, r.v, 32);
+  }
+}
+
+// ---- Pippenger MSM over G1 ----
+// points: n * 8 limbs (x,y affine standard form; (0,0) = infinity, skipped)
+// scalars: n * 4 limbs standard form
+// out: 8 limbs affine + is_inf flag
+
+static inline unsigned window_of(const u64* s, unsigned w, unsigned c) {
+  unsigned bit = w * c;
+  unsigned limb = bit >> 6, off = bit & 63;
+  u64 v = s[limb] >> off;
+  if (off + c > 64 && limb + 1 < 4) v |= s[limb + 1] << (64 - off);
+  return (unsigned)(v & (((u64)1 << c) - 1));
+}
+
+void g1_msm(const u64* points, const u64* scalars, size_t n, int nthreads,
+            u64* out_xy, int* out_inf) {
+  spectre_init();
+  const FpCtx& C = g_fq;
+  unsigned c = 13;
+  if (n < (1u << 12)) c = 8;
+  if (n < (1u << 6)) c = 4;
+  const unsigned nwin = (254 + c - 1) / c;
+  const size_t nbuckets = ((size_t)1 << c) - 1;
+
+  // pre-convert points to Montgomery affine
+  std::vector<Fp> px(n), py(n);
+  std::vector<char> pinf(n);
+  for (size_t i = 0; i < n; ++i) {
+    Fp x, y;
+    std::memcpy(x.v, points + 8 * i, 32);
+    std::memcpy(y.v, points + 8 * i + 4, 32);
+    pinf[i] = fp_is_zero(x) && fp_is_zero(y);
+    to_mont(px[i], x, C);
+    to_mont(py[i], y, C);
+  }
+
+  std::vector<G1> win_res(nwin);
+  auto do_window = [&](unsigned w) {
+    std::vector<G1> buckets(nbuckets);
+    for (auto& b : buckets) g1_set_inf(b);
+    for (size_t i = 0; i < n; ++i) {
+      if (pinf[i]) continue;
+      unsigned idx = window_of(scalars + 4 * i, w, c);
+      if (idx) g1_madd(buckets[idx - 1], buckets[idx - 1], px[i], py[i]);
+    }
+    G1 sum, acc;
+    g1_set_inf(sum);
+    g1_set_inf(acc);
+    for (size_t b = nbuckets; b-- > 0;) {
+      g1_add(sum, sum, buckets[b]);
+      g1_add(acc, acc, sum);
+    }
+    win_res[w] = acc;
+  };
+
+  if (nthreads > 1) {
+    std::vector<std::thread> pool;
+    for (int t = 0; t < nthreads; ++t) {
+      pool.emplace_back([&, t]() {
+        for (unsigned w = t; w < nwin; w += nthreads) do_window(w);
+      });
+    }
+    for (auto& th : pool) th.join();
+  } else {
+    for (unsigned w = 0; w < nwin; ++w) do_window(w);
+  }
+
+  G1 res;
+  g1_set_inf(res);
+  for (unsigned w = nwin; w-- > 0;) {
+    for (unsigned d = 0; d < c && !g1_is_inf(res); ++d) g1_dbl(res, res);
+    g1_add(res, res, win_res[w]);
+  }
+  if (g1_is_inf(res)) {
+    *out_inf = 1;
+    std::memset(out_xy, 0, 64);
+    return;
+  }
+  *out_inf = 0;
+  Fp ax, ay;
+  g1_to_affine_inner(ax, ay, res);
+  from_mont(ax, ax, C);
+  from_mont(ay, ay, C);
+  std::memcpy(out_xy, ax.v, 32);
+  std::memcpy(out_xy + 4, ay.v, 32);
+}
+
+// ---- batched G1 ops for testing device EC kernels ----
+
+// out = a + b where a, b, out are affine standard-form; (0,0) = infinity
+void g1_add_affine_batch(const u64* a, const u64* b, u64* out, size_t n) {
+  spectre_init();
+  const FpCtx& C = g_fq;
+  for (size_t i = 0; i < n; ++i) {
+    Fp ax, ay, bx, by;
+    std::memcpy(ax.v, a + 8 * i, 32);
+    std::memcpy(ay.v, a + 8 * i + 4, 32);
+    std::memcpy(bx.v, b + 8 * i, 32);
+    std::memcpy(by.v, b + 8 * i + 4, 32);
+    bool ainf = fp_is_zero(ax) && fp_is_zero(ay);
+    bool binf = fp_is_zero(bx) && fp_is_zero(by);
+    G1 pa;
+    if (ainf) {
+      g1_set_inf(pa);
+    } else {
+      to_mont(pa.x, ax, C);
+      to_mont(pa.y, ay, C);
+      pa.z = C.one;
+    }
+    if (!binf) {
+      Fp bxm, bym;
+      to_mont(bxm, bx, C);
+      to_mont(bym, by, C);
+      g1_madd(pa, pa, bxm, bym);
+    }
+    if (g1_is_inf(pa)) {
+      std::memset(out + 8 * i, 0, 64);
+    } else {
+      Fp ox, oy;
+      g1_to_affine_inner(ox, oy, pa);
+      from_mont(ox, ox, C);
+      from_mont(oy, oy, C);
+      std::memcpy(out + 8 * i, ox.v, 32);
+      std::memcpy(out + 8 * i + 4, oy.v, 32);
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,124 @@
+"""C++ host library (libspectre_host.so) vs pure-Python oracle."""
+
+import secrets
+
+import numpy as np
+import pytest
+
+from spectre_tpu.fields import bn254 as bn
+from spectre_tpu.native import host
+
+pytestmark = pytest.mark.skipif(not host.available(), reason="native lib unavailable")
+
+
+def rand_fr(n):
+    return [secrets.randbelow(bn.R) for _ in range(n)]
+
+
+class TestFieldOps:
+    def test_mul(self):
+        a, b = rand_fr(64), rand_fr(64)
+        got = host.limbs_to_ints(
+            host.fp_mul_batch(host.FR, host.ints_to_limbs(a), host.ints_to_limbs(b)))
+        assert got == [x * y % bn.R for x, y in zip(a, b)]
+
+    def test_fq_mul(self):
+        a = [secrets.randbelow(bn.P) for _ in range(32)]
+        b = [secrets.randbelow(bn.P) for _ in range(32)]
+        got = host.limbs_to_ints(
+            host.fp_mul_batch(host.FQ, host.ints_to_limbs(a), host.ints_to_limbs(b)))
+        assert got == [x * y % bn.P for x, y in zip(a, b)]
+
+    def test_add_sub(self):
+        a, b = rand_fr(32), rand_fr(32)
+        al, bl = host.ints_to_limbs(a), host.ints_to_limbs(b)
+        assert host.limbs_to_ints(host.fp_add_batch(host.FR, al, bl)) == \
+            [(x + y) % bn.R for x, y in zip(a, b)]
+        assert host.limbs_to_ints(host.fp_sub_batch(host.FR, al, bl)) == \
+            [(x - y) % bn.R for x, y in zip(a, b)]
+
+    def test_inv_batch_with_zero(self):
+        a = rand_fr(16)
+        a[5] = 0  # inv(0) := 0 convention
+        got = host.limbs_to_ints(host.fp_inv_batch(host.FR, host.ints_to_limbs(a)))
+        for x, g in zip(a, got):
+            assert g == (0 if x == 0 else pow(x, -1, bn.R))
+
+    def test_edge_values(self):
+        a = [0, 1, bn.R - 1, bn.R - 2]
+        b = [bn.R - 1, bn.R - 1, bn.R - 1, 2]
+        got = host.limbs_to_ints(
+            host.fp_mul_batch(host.FR, host.ints_to_limbs(a), host.ints_to_limbs(b)))
+        assert got == [x * y % bn.R for x, y in zip(a, b)]
+
+
+class TestNTT:
+    @pytest.mark.parametrize("k", [1, 3, 6, 10])
+    def test_matches_naive_dft(self, k):
+        n = 1 << k
+        w = bn.fr_root_of_unity(k)
+        data = rand_fr(n)
+        dl = host.ints_to_limbs(data)
+        host.fr_ntt(dl, w)
+        got = host.limbs_to_ints(dl)
+        if k <= 6:
+            want = [sum(data[j] * pow(w, i * j, bn.R) for j in range(n)) % bn.R
+                    for i in range(n)]
+            assert got == want
+        # inverse via omega^{-1} and scaling recovers input for all k
+        dl2 = host.ints_to_limbs(got)
+        host.fr_ntt(dl2, pow(w, -1, bn.R))
+        ninv = pow(n, -1, bn.R)
+        back = [x * ninv % bn.R for x in host.limbs_to_ints(dl2)]
+        assert back == data
+
+
+class TestMSM:
+    def test_small_oracle(self):
+        g = bn.G1_GEN
+        pts = [g, bn.g1_curve.mul(g, 7), bn.g1_curve.mul(g, 1234567)]
+        scalars = [3, 9, bn.R - 5]
+        got = host.g1_msm(host.points_to_limbs(pts), host.ints_to_limbs(scalars))
+        want = bn.g1_curve.msm(pts, scalars)
+        assert got == (int(want[0]), int(want[1]))
+
+    def test_edge_cases(self):
+        g = bn.G1_GEN
+        pts = [None, g, bn.g1_curve.mul(g, 3), bn.g1_curve.mul(g, 11)]
+        scalars = [5, 0, secrets.randbelow(bn.R), 1]
+        got = host.g1_msm(host.points_to_limbs(pts), host.ints_to_limbs(scalars))
+        want = bn.g1_curve.msm(pts, scalars)
+        assert got == (int(want[0]), int(want[1]))
+
+    def test_cancellation_to_infinity(self):
+        g = bn.G1_GEN
+        pts = [g, bn.g1_curve.neg(g)]
+        got = host.g1_msm(host.points_to_limbs(pts), host.ints_to_limbs([7, 7]))
+        assert got is None
+
+    def test_medium_random(self):
+        n = 128
+        g = bn.G1_GEN
+        pts = [bn.g1_curve.mul(g, secrets.randbelow(bn.R)) for _ in range(n)]
+        scalars = rand_fr(n)
+        got = host.g1_msm(host.points_to_limbs(pts), host.ints_to_limbs(scalars))
+        want = bn.g1_curve.msm(pts, scalars)
+        assert got == (int(want[0]), int(want[1]))
+
+
+class TestBatchedAdd:
+    def test_all_cases(self):
+        g = bn.G1_GEN
+        a = [bn.g1_curve.mul(g, k + 1) for k in range(6)] + [None, g, None]
+        b = [bn.g1_curve.mul(g, 100 + k) for k in range(6)] + [g, None, None]
+        b[2] = a[2]                   # doubling
+        b[3] = bn.g1_curve.neg(a[3])  # cancellation
+        got = host.g1_add_affine_batch(host.points_to_limbs(a), host.points_to_limbs(b))
+        for i in range(len(a)):
+            want = bn.g1_curve.add(a[i], b[i])
+            gx = sum(int(got[i, j]) << (64 * j) for j in range(4))
+            gy = sum(int(got[i, 4 + j]) << (64 * j) for j in range(4))
+            if want is None:
+                assert (gx, gy) == (0, 0)
+            else:
+                assert (gx, gy) == (int(want[0]), int(want[1]))
