@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Benchmark entry point: BN254 MSM throughput, TPU vs measured CPU baseline.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The metric is the north star from BASELINE.md: BN254 MSM points/s (the
+dominant prover cost). Baseline = this repo's native C++ single-thread
+Pippenger measured on this machine (the reference Rust prover cannot run here;
+its MSM is the same algorithm on the same hardware class).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_points(n: int) -> np.ndarray:
+    """n distinct affine points as [n, 8] u64 limbs via the native lib."""
+    from spectre_tpu.fields import bn254 as bn
+    from spectre_tpu.native import host
+
+    base = host.points_to_limbs([bn.G1_GEN])
+    arrs = [base]
+    total = 1
+    while total < n:
+        allp = np.concatenate(arrs)
+        new = host.g1_add_affine_batch(allp, np.roll(allp, 1, axis=0))
+        arrs.append(new)
+        total *= 2
+    return np.concatenate(arrs)[:n]
+
+
+def main():
+    import jax
+    # per-platform compile cache: axon-remote-compiled AOT entries are not
+    # loadable by the CPU backend (machine-feature mismatch)
+    jax.config.update("jax_compilation_cache_dir",
+                      f"/tmp/jax_cache_{jax.default_backend()}")
+    import jax.numpy as jnp
+
+    from spectre_tpu.native import host
+    from spectre_tpu.ops import ec, field_ops as F, limbs as L, msm as MSM
+
+    logn = int(os.environ.get("BENCH_LOGN", "16"))
+    n = 1 << logn
+    c = 13 if logn >= 18 else 10
+
+    pts64 = build_points(n)
+    rng = np.random.default_rng(7)
+    sc64 = rng.integers(0, 2**63, size=(n, 4), dtype=np.uint64)
+    sc64[:, 3] &= (1 << 61) - 1
+
+    # --- CPU baseline (native C++ Pippenger, single thread) ---
+    t0 = time.time()
+    cpu_res = host.g1_msm(pts64, sc64)
+    cpu_dt = time.time() - t0
+
+    # --- TPU (or default backend) ---
+    ctxq = F.fq_ctx()
+    x16 = L.u64limbs_to_u16limbs(pts64[:, :4])
+    y16 = L.u64limbs_to_u16limbs(pts64[:, 4:])
+    to_mont = jax.jit(lambda v: F.to_mont(ctxq, v))
+    xm, ym = to_mont(jnp.asarray(x16)), to_mont(jnp.asarray(y16))
+    one = jnp.broadcast_to(jnp.asarray(ctxq.one_mont), (n, F.NLIMBS))
+    pts = jnp.stack([xm, ym, one], axis=1)
+    sc16 = jnp.asarray(L.u64limbs_to_u16limbs(sc64))
+
+    def run():
+        # NOTE: block_until_ready is not reliable through the axon tunnel;
+        # a host transfer (np.asarray) is the only trustworthy sync point.
+        return np.asarray(MSM.combine_windows(MSM.msm_windows(pts, sc16, c), c))
+
+    res = run()  # compile + first run
+    iters = 3
+    t0 = time.time()
+    for _ in range(iters):
+        res = run()
+    tpu_dt = (time.time() - t0) / iters
+
+    got = ec.decode_points(jnp.asarray(res)[None])[0]
+    assert got == cpu_res, "TPU MSM result != CPU baseline result"
+
+    value = n / tpu_dt
+    baseline = n / cpu_dt
+    print(json.dumps({
+        "metric": f"bn254_msm_2^{logn} throughput",
+        "value": round(value),
+        "unit": "points/s",
+        "vs_baseline": round(value / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

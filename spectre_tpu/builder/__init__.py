@@ -1,0 +1,12 @@
+"""Virtual circuit builder: the halo2-lib equivalent layer.
+
+Reference parity (SURVEY.md L2): halo2-base's `BaseCircuitBuilder` / `Context` /
+`GateChip` / `RangeChip` — circuit logic appends virtual cells to streams; a
+finalize pass lays streams out across physical columns (the break-point
+system), producing a plonk.Assignment. App circuits (models/) are written
+against these chips, never against raw columns.
+"""
+
+from .context import AssignedValue, Context  # noqa: F401
+from .gate import GateChip  # noqa: F401
+from .range_chip import RangeChip  # noqa: F401

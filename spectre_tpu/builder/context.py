@@ -1,0 +1,201 @@
+"""Context: virtual advice/lookup cell streams + copy manager + finalizer.
+
+Reference parity: halo2-base `Context`/`SinglePhaseCoreManager` and the
+shared copy-constraint manager (`gadget/crypto/builder.rs:56-63`); the
+finalize pass is the break-points system (`config/*.json` break_points,
+SURVEY.md §2c witness-layout parallelism): the single logical stream is cut
+at gate-unit boundaries across physical advice columns.
+
+Cells are python ints mod R; every op appends a unit of 1 or 4 cells (a bare
+witness or one vertical-gate activation q*(s0 + s1*s2 - s3) = 0).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..fields import bn254
+from ..plonk.constraint_system import Assignment, CircuitConfig
+
+R = bn254.R
+
+
+@dataclass(frozen=True)
+class AssignedValue:
+    """Handle to a stream cell: (stream id, index). value is a cached int."""
+
+    ctx: "Context"
+    stream: str      # "adv" | "lkp"
+    index: int
+
+    @property
+    def value(self) -> int:
+        return self.ctx.stream_values(self.stream)[self.index]
+
+    def __repr__(self):
+        return f"AV({self.stream}[{self.index}]=0x{self.value:x})"
+
+
+class Context:
+    def __init__(self):
+        self.adv_values: list[int] = []       # advice stream
+        self.adv_units: list[tuple[int, int, bool]] = []  # (start, size, gated)
+        self.lkp_values: list[int] = []       # lookup stream (range-checked)
+        self.copies: list[tuple] = []         # ((stream, idx), (stream, idx))
+        self.constants: dict[int, int] = {}   # value -> fixed row
+        self.const_uses: list[tuple[int, int]] = []  # (adv idx, fixed row)
+        self.instance_cells: list[AssignedValue] = []
+
+    # -- stream access --
+    def stream_values(self, stream: str) -> list[int]:
+        return self.adv_values if stream == "adv" else self.lkp_values
+
+    # -- primitive appends --
+    def _push_unit(self, vals: list[int], gated: bool) -> int:
+        start = len(self.adv_values)
+        self.adv_values.extend(v % R for v in vals)
+        self.adv_units.append((start, len(vals), gated))
+        return start
+
+    def load_witness(self, v: int) -> AssignedValue:
+        start = self._push_unit([v], gated=False)
+        return AssignedValue(self, "adv", start)
+
+    def load_constant(self, v: int) -> AssignedValue:
+        v = int(v) % R
+        start = self._push_unit([v], gated=False)
+        row = self.constants.setdefault(v, len(self.constants))
+        self.const_uses.append((start, row))
+        return AssignedValue(self, "adv", start)
+
+    def load_zero(self) -> AssignedValue:
+        return self.load_constant(0)
+
+    def gate_unit(self, vals: list[int], copy_from: list) -> list[AssignedValue]:
+        """Append a gated 4-cell unit. copy_from[i] is None (fresh cell),
+        an AssignedValue (equality to an existing cell), or ("const", v)."""
+        assert len(vals) == 4
+        start = self._push_unit(vals, gated=True)
+        out = []
+        for i, src in enumerate(copy_from):
+            av = AssignedValue(self, "adv", start + i)
+            if isinstance(src, AssignedValue):
+                assert src.value == vals[i] % R, "copy value mismatch"
+                self.copies.append((("adv", src.index) if src.stream == "adv"
+                                    else ("lkp", src.index), ("adv", start + i)))
+            elif isinstance(src, tuple) and src and src[0] == "const":
+                row = self.constants.setdefault(src[1] % R, len(self.constants))
+                self.const_uses.append((start + i, row))
+            out.append(av)
+        return out
+
+    def push_lookup(self, av: AssignedValue) -> None:
+        """Copy a cell into the lookup (range-table) stream."""
+        idx = len(self.lkp_values)
+        self.lkp_values.append(av.value)
+        self.copies.append((("adv", av.index), ("lkp", idx)))
+
+    def constrain_equal(self, a: AssignedValue, b: AssignedValue):
+        assert a.value == b.value, "constrain_equal on unequal values"
+        self.copies.append(((a.stream, a.index), (b.stream, b.index)))
+
+    def constrain_constant(self, a: AssignedValue, v: int):
+        assert a.value == int(v) % R, "constrain_constant mismatch"
+        row = self.constants.setdefault(int(v) % R, len(self.constants))
+        self.const_uses.append((a.index, row))
+        assert a.stream == "adv"
+
+    def expose_public(self, a: AssignedValue):
+        """Append a cell to the instance column (copy-constrained)."""
+        self.instance_cells.append(a)
+
+    # ------------------------------------------------------------------
+    # finalize: streams -> physical columns -> plonk.Assignment
+    # ------------------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "advice_cells": len(self.adv_values),
+            "lookup_cells": len(self.lkp_values),
+            "copies": len(self.copies),
+            "constants": len(self.constants),
+            "instances": len(self.instance_cells),
+        }
+
+    def auto_config(self, k: int, lookup_bits: int, min_advice: int = 1) -> CircuitConfig:
+        """Column counts sized from actual stream lengths (reference parity:
+        halo2-lib `calculate_params`, `sync_step_circuit.rs:421-427`)."""
+        probe = CircuitConfig(k=k, num_advice=1, num_lookup_advice=1,
+                              num_fixed=1, lookup_bits=lookup_bits)
+        u = probe.usable_rows
+        # advice columns: account for per-unit padding at column breaks (worst
+        # case wastes <= 3 rows per column)
+        num_advice = max(min_advice, (len(self.adv_values) + u - 1) // (u - 3))
+        num_lookup = max(1, (len(self.lkp_values) + u - 1) // u)
+        num_fixed = max(1, (len(self.constants) + u - 1) // u)
+        return CircuitConfig(k=k, num_advice=num_advice,
+                             num_lookup_advice=num_lookup, num_fixed=num_fixed,
+                             lookup_bits=lookup_bits)
+
+    def layout(self, cfg: CircuitConfig):
+        """Place units into columns. Returns (advice_cols, lookup_cols,
+        fixed_cols, selectors, copies, instances) for plonk.Assignment —
+        and the break points (row where each column's stream segment ends)."""
+        n, u = cfg.n, cfg.usable_rows
+        advice = [[0] * n for _ in range(cfg.num_advice)]
+        selectors = [[0] * n for _ in range(cfg.num_advice)]
+        placement = {}  # adv stream idx -> (col, row)
+        col, row = 0, 0
+        break_points = []
+        for start, size, gated in self.adv_units:
+            if row + size > u:
+                break_points.append(row)
+                col += 1
+                row = 0
+                assert col < cfg.num_advice, "advice overflow: raise k or columns"
+            for i in range(size):
+                advice[col][row + i] = self.adv_values[start + i]
+                placement[start + i] = (col, row + i)
+            if gated:
+                selectors[col][row] = 1
+            row += size
+        break_points.append(row)
+
+        lookup = [[0] * n for _ in range(cfg.num_lookup_advice)]
+        lkp_placement = {}
+        for idx, v in enumerate(self.lkp_values):
+            c, r = divmod(idx, u)
+            assert c < cfg.num_lookup_advice, "lookup overflow"
+            lookup[c][r] = v
+            lkp_placement[idx] = (c, r)
+
+        fixed = [[0] * n for _ in range(cfg.num_fixed)]
+        fix_placement = {}
+        for v, row_f in self.constants.items():
+            c, r = divmod(row_f, u)
+            assert c < cfg.num_fixed, "fixed overflow"
+            fixed[c][r] = v
+            fix_placement[row_f] = (c, r)
+
+        # translate copies to global column coordinates
+        def cell_coord(stream, idx):
+            if stream == "adv":
+                c, r = placement[idx]
+                return (cfg.col_gate_advice(c), r)
+            c, r = lkp_placement[idx]
+            return (cfg.col_lookup_advice(c), r)
+
+        copies = [(cell_coord(*a), cell_coord(*b)) for a, b in self.copies]
+        for adv_idx, fix_row in self.const_uses:
+            c, r = fix_placement[fix_row]
+            copies.append((cell_coord("adv", adv_idx), (cfg.col_fixed(c), r)))
+
+        instances = [[av.value for av in self.instance_cells]]
+        for i, av in enumerate(self.instance_cells):
+            copies.append((cell_coord(av.stream, av.index),
+                           (cfg.col_instance(0), i)))
+        return advice, lookup, fixed, selectors, copies, instances, break_points
+
+    def assignment(self, cfg: CircuitConfig) -> Assignment:
+        advice, lookup, fixed, selectors, copies, instances, _bp = self.layout(cfg)
+        return Assignment(cfg, advice, lookup, fixed, selectors, instances, copies)

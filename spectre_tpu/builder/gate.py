@@ -1,0 +1,165 @@
+"""GateChip: arithmetic over the vertical gate q*(s0 + s1*s2 - s3) = 0.
+
+Reference parity: halo2-base `GateChip` (flex-gate instructions) — add, mul,
+mul_add, select, is_zero, inner products, bit decomposition. Every op appends
+one or more 4-cell gate units; inputs are copy-constrained into the unit.
+"""
+
+from __future__ import annotations
+
+from ..fields import bn254
+from .context import AssignedValue, Context
+
+R = bn254.R
+
+
+def _v(x) -> int:
+    return x.value if isinstance(x, AssignedValue) else int(x) % R
+
+
+class GateChip:
+    # -- basic ops ------------------------------------------------------
+    def add(self, ctx: Context, a, b) -> AssignedValue:
+        """out = a + b  via  [a, b, 1, out]."""
+        av, bv = _v(a), _v(b)
+        cells = ctx.gate_unit([av, bv, 1, (av + bv) % R],
+                              [a if isinstance(a, AssignedValue) else ("const", av),
+                               b if isinstance(b, AssignedValue) else ("const", bv),
+                               ("const", 1), None])
+        return cells[3]
+
+    def sub(self, ctx: Context, a, b) -> AssignedValue:
+        """out = a - b  via  [out, b, 1, a]."""
+        av, bv = _v(a), _v(b)
+        cells = ctx.gate_unit([(av - bv) % R, bv, 1, av],
+                              [None,
+                               b if isinstance(b, AssignedValue) else ("const", bv),
+                               ("const", 1),
+                               a if isinstance(a, AssignedValue) else ("const", av)])
+        return cells[0]
+
+    def neg(self, ctx: Context, a) -> AssignedValue:
+        return self.sub(ctx, 0, a)
+
+    def mul(self, ctx: Context, a, b) -> AssignedValue:
+        """out = a * b  via  [0, a, b, out]."""
+        av, bv = _v(a), _v(b)
+        cells = ctx.gate_unit([0, av, bv, av * bv % R],
+                              [("const", 0),
+                               a if isinstance(a, AssignedValue) else ("const", av),
+                               b if isinstance(b, AssignedValue) else ("const", bv),
+                               None])
+        return cells[3]
+
+    def mul_add(self, ctx: Context, a, b, c) -> AssignedValue:
+        """out = a * b + c  via  [c, a, b, out]."""
+        av, bv, cv = _v(a), _v(b), _v(c)
+        cells = ctx.gate_unit([cv, av, bv, (cv + av * bv) % R],
+                              [c if isinstance(c, AssignedValue) else ("const", cv),
+                               a if isinstance(a, AssignedValue) else ("const", av),
+                               b if isinstance(b, AssignedValue) else ("const", bv),
+                               None])
+        return cells[3]
+
+    def div_unsafe(self, ctx: Context, a, b) -> AssignedValue:
+        """out = a / b (b must be nonzero; only the product is constrained)."""
+        av, bv = _v(a), _v(b)
+        q = av * pow(bv, -1, R) % R
+        cells = ctx.gate_unit([0, q, bv, av],
+                              [("const", 0), None,
+                               b if isinstance(b, AssignedValue) else ("const", bv),
+                               a if isinstance(a, AssignedValue) else ("const", av)])
+        return cells[1]
+
+    # -- boolean -------------------------------------------------------
+    def assert_bit(self, ctx: Context, a: AssignedValue):
+        """a * a = a  via  [0, a, a, a]."""
+        av = _v(a)
+        ctx.gate_unit([0, av, av, av], [("const", 0), a, a, a])
+
+    def and_(self, ctx: Context, a, b) -> AssignedValue:
+        return self.mul(ctx, a, b)
+
+    def not_(self, ctx: Context, a) -> AssignedValue:
+        return self.sub(ctx, 1, a)
+
+    def or_(self, ctx: Context, a, b) -> AssignedValue:
+        # a + b - a*b
+        ab = self.mul(ctx, a, b)
+        s = self.add(ctx, a, b)
+        return self.sub(ctx, s, ab)
+
+    def select(self, ctx: Context, a, b, sel) -> AssignedValue:
+        """sel ? a : b  =  b + sel*(a-b)."""
+        d = self.sub(ctx, a, b)
+        return self.mul_add(ctx, sel, d, b)
+
+    def is_zero(self, ctx: Context, a) -> AssignedValue:
+        """out = (a == 0), via out*a = 0 and out + a*inv = 1."""
+        av = _v(a)
+        out_v = 1 if av == 0 else 0
+        inv_v = 0 if av == 0 else pow(av, -1, R)
+        a_src = a if isinstance(a, AssignedValue) else ("const", av)
+        # 0 + out*a = 0
+        cells = ctx.gate_unit([0, out_v, av, 0],
+                              [("const", 0), None, a_src, ("const", 0)])
+        out = cells[1]
+        # out + a*inv = 1
+        ctx.gate_unit([out_v, av, inv_v, 1],
+                      [out, a_src if not isinstance(a, AssignedValue) else a,
+                       None, ("const", 1)])
+        return out
+
+    def is_equal(self, ctx: Context, a, b) -> AssignedValue:
+        return self.is_zero(ctx, self.sub(ctx, a, b))
+
+    # -- aggregates ----------------------------------------------------
+    def sum_(self, ctx: Context, vals) -> AssignedValue:
+        acc = None
+        for v in vals:
+            acc = v if acc is None else self.add(ctx, acc, v)
+        return acc if acc is not None else ctx.load_zero()
+
+    def inner_product(self, ctx: Context, a_vals, b_vals) -> AssignedValue:
+        """sum a_i * b_i as a mul_add chain."""
+        assert len(a_vals) == len(b_vals) and a_vals
+        acc = self.mul(ctx, a_vals[0], b_vals[0])
+        for x, y in zip(a_vals[1:], b_vals[1:]):
+            acc = self.mul_add(ctx, x, y, acc)
+        return acc
+
+    def inner_product_const(self, ctx: Context, vals, consts) -> AssignedValue:
+        """sum vals_i * c_i with host constants c_i."""
+        assert len(vals) == len(consts) and vals
+        acc = self.mul(ctx, vals[0], int(consts[0]) % R)
+        for x, cst in zip(vals[1:], consts[1:]):
+            acc = self.mul_add(ctx, x, int(cst) % R, acc)
+        return acc
+
+    def num_to_bits(self, ctx: Context, a: AssignedValue, nbits: int) -> list:
+        """Little-endian bit decomposition, each bit boolean-constrained and
+        the recomposition equality-constrained to a."""
+        av = _v(a)
+        assert av < (1 << nbits), "value too large for bit width"
+        bits = []
+        for i in range(nbits):
+            b = ctx.load_witness((av >> i) & 1)
+            self.assert_bit(ctx, b)
+            bits.append(b)
+        acc = self.inner_product_const(ctx, bits, [1 << i for i in range(nbits)])
+        ctx.constrain_equal(acc, a)
+        return bits
+
+    def bits_to_num(self, ctx: Context, bits) -> AssignedValue:
+        return self.inner_product_const(ctx, bits, [1 << i for i in range(len(bits))])
+
+    def pow_const(self, ctx: Context, a: AssignedValue, e: int) -> AssignedValue:
+        result = None
+        base = a
+        while e:
+            if e & 1:
+                result = base if result is None else self.mul(ctx, result, base)
+            e >>= 1
+            if e:
+                base = self.mul(ctx, base, base)
+        return result if result is not None else ctx.load_constant(1)

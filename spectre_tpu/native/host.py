@@ -57,6 +57,18 @@ class HostLib:
             lib.g1_msm.restype = None
             lib.g1_add_affine_batch.argtypes = [u64p, u64p, u64p, ctypes.c_size_t]
             lib.g1_add_affine_batch.restype = None
+            lib.g1_scalar_powers.argtypes = [u64p, u64p, ctypes.c_size_t, u64p]
+            lib.g1_scalar_powers.restype = None
+            lib.fp_horner.argtypes = [ctypes.c_int, u64p, u64p, u64p, ctypes.c_size_t]
+            lib.fp_horner.restype = None
+            lib.fp_sum.argtypes = [ctypes.c_int, u64p, u64p, ctypes.c_size_t]
+            lib.fp_sum.restype = None
+            lib.fp_scale_batch.argtypes = [ctypes.c_int, u64p, u64p, u64p, ctypes.c_size_t]
+            lib.fp_scale_batch.restype = None
+            lib.fp_powers.argtypes = [ctypes.c_int, u64p, u64p, ctypes.c_size_t]
+            lib.fp_powers.restype = None
+            lib.fp_prefix_prod.argtypes = [ctypes.c_int, u64p, u64p, ctypes.c_size_t]
+            lib.fp_prefix_prod.restype = None
             lib.spectre_init()
             inst = super().__new__(cls)
             inst.lib = lib
@@ -187,3 +199,60 @@ def g1_add_affine_batch(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     out = np.empty_like(a)
     lib.g1_add_affine_batch(_u64p(a), _u64p(b), _u64p(out), a.shape[0])
     return out
+
+
+def g1_scalar_powers(g, tau: int, n: int) -> np.ndarray:
+    """[n, 8] limbs: tau^i * g for i in [0, n). g = affine (x, y) ints."""
+    lib = HostLib().lib
+    gl = ints_to_limbs([int(g[0]), int(g[1])]).reshape(8)
+    tl = ints_to_limbs([tau]).reshape(4)
+    out = np.zeros((n, 8), dtype=np.uint64)
+    lib.g1_scalar_powers(_u64p(gl), _u64p(tl), n, _u64p(out))
+    return out
+
+
+def fp_scale_batch(field: int, a: np.ndarray, s: int) -> np.ndarray:
+    lib = HostLib().lib
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    assert a.ndim == 2 and a.shape[1] == 4
+    sl = ints_to_limbs([s]).reshape(4)
+    out = np.empty_like(a)
+    lib.fp_scale_batch(field, _u64p(a), _u64p(sl), _u64p(out), a.shape[0])
+    return out
+
+
+def fp_powers(field: int, x: int, n: int) -> np.ndarray:
+    lib = HostLib().lib
+    xl = ints_to_limbs([x]).reshape(4)
+    out = np.zeros((n, 4), dtype=np.uint64)
+    lib.fp_powers(field, _u64p(xl), _u64p(out), n)
+    return out
+
+
+def fp_prefix_prod(field: int, a: np.ndarray) -> np.ndarray:
+    lib = HostLib().lib
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    assert a.ndim == 2 and a.shape[1] == 4
+    out = np.empty_like(a)
+    lib.fp_prefix_prod(field, _u64p(a), _u64p(out), a.shape[0])
+    return out
+
+
+def fp_horner(field: int, a: np.ndarray, x: int) -> int:
+    """Evaluate sum a[i] x^i (coefficients little-index-first)."""
+    lib = HostLib().lib
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    assert a.ndim == 2 and a.shape[1] == 4
+    xl = ints_to_limbs([x]).reshape(4)
+    out = np.zeros(4, dtype=np.uint64)
+    lib.fp_horner(field, _u64p(a), _u64p(xl), _u64p(out), a.shape[0])
+    return sum(int(out[j]) << (64 * j) for j in range(4))
+
+
+def fp_sum(field: int, a: np.ndarray) -> int:
+    lib = HostLib().lib
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    assert a.ndim == 2 and a.shape[1] == 4
+    out = np.zeros(4, dtype=np.uint64)
+    lib.fp_sum(field, _u64p(a), _u64p(out), a.shape[0])
+    return sum(int(out[j]) << (64 * j) for j in range(4))

@@ -601,4 +601,145 @@ void g1_add_affine_batch(const u64* a, const u64* b, u64* out, size_t n) {
   }
 }
 
+// Horner evaluation: out = sum a[i] x^i (a standard form, length n)
+void fp_horner(int field, const u64* a, const u64* x, u64* out, size_t n) {
+  const FpCtx& C = pick(field);
+  Fp xm, acc;
+  std::memcpy(xm.v, x, 32);
+  to_mont(xm, xm, C);
+  std::memset(acc.v, 0, 32);
+  for (size_t i = n; i-- > 0;) {
+    Fp ai;
+    std::memcpy(ai.v, a + 4 * i, 32);
+    to_mont(ai, ai, C);
+    fp_mul(acc, acc, xm, C);
+    fp_add(acc, acc, ai, C);
+  }
+  from_mont(acc, acc, C);
+  std::memcpy(out, acc.v, 32);
+}
+
+// sum of all elements
+void fp_sum(int field, const u64* a, u64* out, size_t n) {
+  const FpCtx& C = pick(field);
+  Fp acc;
+  std::memset(acc.v, 0, 32);
+  for (size_t i = 0; i < n; ++i) {
+    Fp ai;
+    std::memcpy(ai.v, a + 4 * i, 32);
+    fp_add(acc, acc, ai, C);
+  }
+  std::memcpy(out, acc.v, 32);
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// SRS generation: out[i] = tau^i * G, affine standard form [n, 8] limbs.
+// Sequential chain P_{i+1} = tau * P_i with jacobian double-and-add.
+void g1_scalar_powers(const u64* g_xy, const u64* tau, size_t n, u64* out) {
+  spectre_init();
+  const FpCtx& C = g_fq;
+  Fp gx, gy;
+  std::memcpy(gx.v, g_xy, 32);
+  std::memcpy(gy.v, g_xy + 4, 32);
+  G1 cur;
+  to_mont(cur.x, gx, C);
+  to_mont(cur.y, gy, C);
+  cur.z = C.one;
+  std::vector<G1> jac(n);
+  for (size_t i = 0; i < n; ++i) {
+    jac[i] = cur;
+    if (i + 1 < n) {
+      // cur = tau * cur
+      G1 acc;
+      g1_set_inf(acc);
+      G1 base = cur;
+      for (int limb = 0; limb < 4; ++limb) {
+        u64 bits = tau[limb];
+        for (int b = 0; b < 64; ++b) {
+          if (bits & 1) g1_add(acc, acc, base);
+          g1_dbl(base, base);
+          bits >>= 1;
+        }
+      }
+      cur = acc;
+    }
+  }
+  // batch-normalize to affine: montgomery batch inversion of z, skipping
+  // infinity points (z == 0 would otherwise poison the whole product)
+  std::vector<Fp> zs(n), prefix(n);
+  Fp accp = C.one;
+  for (size_t i = 0; i < n; ++i) {
+    zs[i] = jac[i].z;
+    prefix[i] = accp;
+    if (!fp_is_zero(zs[i])) fp_mul(accp, accp, zs[i], C);
+  }
+  Fp inv_acc;
+  fp_inv(inv_acc, accp, C);
+  for (size_t i = n; i-- > 0;) {
+    if (fp_is_zero(zs[i])) {
+      std::memset(out + 8 * i, 0, 64);  // infinity -> (0, 0)
+      continue;
+    }
+    Fp zinv, zinv2, zinv3, ax, ay;
+    fp_mul(zinv, inv_acc, prefix[i], C);
+    fp_mul(inv_acc, inv_acc, zs[i], C);
+    fp_sqr(zinv2, zinv, C);
+    fp_mul(zinv3, zinv2, zinv, C);
+    fp_mul(ax, jac[i].x, zinv2, C);
+    fp_mul(ay, jac[i].y, zinv3, C);
+    from_mont(ax, ax, C);
+    from_mont(ay, ay, C);
+    std::memcpy(out + 8 * i, ax.v, 32);
+    std::memcpy(out + 8 * i + 4, ay.v, 32);
+  }
+}
+
+// pointwise ops used by the prover's quotient evaluation (standard form)
+void fp_scale_batch(int field, const u64* a, const u64* s /*4 limbs*/, u64* out, size_t n) {
+  const FpCtx& C = pick(field);
+  Fp sm;
+  std::memcpy(sm.v, s, 32);
+  to_mont(sm, sm, C);
+  for (size_t i = 0; i < n; ++i) {
+    Fp am, r;
+    std::memcpy(am.v, a + 4 * i, 32);
+    to_mont(am, am, C);
+    fp_mul(r, am, sm, C);
+    from_mont(r, r, C);
+    std::memcpy(out + 4 * i, r.v, 32);
+  }
+}
+
+// out[i] = x^i for i in [0, n)
+void fp_powers(int field, const u64* x, u64* out, size_t n) {
+  const FpCtx& C = pick(field);
+  Fp xm, cur;
+  std::memcpy(xm.v, x, 32);
+  to_mont(xm, xm, C);
+  cur = C.one;
+  for (size_t i = 0; i < n; ++i) {
+    Fp r;
+    from_mont(r, cur, C);
+    std::memcpy(out + 4 * i, r.v, 32);
+    fp_mul(cur, cur, xm, C);
+  }
+}
+
+// prefix products: out[i] = prod_{j<=i} a[j]
+void fp_prefix_prod(int field, const u64* a, u64* out, size_t n) {
+  const FpCtx& C = pick(field);
+  Fp acc = C.one;
+  for (size_t i = 0; i < n; ++i) {
+    Fp am, r;
+    std::memcpy(am.v, a + 4 * i, 32);
+    to_mont(am, am, C);
+    fp_mul(acc, acc, am, C);
+    from_mont(r, acc, C);
+    std::memcpy(out + 4 * i, r.v, 32);
+  }
+}
+
 }  // extern "C"

@@ -1,0 +1,17 @@
+"""Device kernels (JAX/XLA/Pallas): the TPU compute path.
+
+Field elements are limb tensors: [..., 16] uint32 arrays holding 16-bit limbs
+(little-endian), Montgomery form with R = 2^256 — the same R as the native C++
+library, so host<->device conversions are pure bit movement. 16-bit limbs are
+the TPU-native choice: products of two limbs fit uint32 (no 64-bit multiply on
+TPU), and column accumulations stay far below 2^32.
+
+Modules:
+  limbs      int <-> limb-tensor conversion (numpy, host side)
+  field_ops  Montgomery arithmetic on limb tensors (vectorized, jit-able)
+  ntt        radix-2 NTT/iNTT over BN254 Fr with per-stage twiddle tables
+  ec         batched BN254 G1 jacobian arithmetic (branchless select form)
+  msm        Pippenger MSM: sort + padded-gather + tree reduction
+  sha256     batched SHA256 over u32 lanes (witness hashing, N6)
+  poseidon   batched Poseidon permutation over Fr (N7) + native params
+"""

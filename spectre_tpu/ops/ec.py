@@ -1,0 +1,136 @@
+"""Batched BN254 G1 arithmetic on limb tensors (device side of N2).
+
+Points are homogeneous projective (X:Y:Z) limb tensors [..., 3, 16] in
+Montgomery form, with infinity = (0:1:0). Addition uses the Renes–Costello–
+Batina COMPLETE formulas for j-invariant-0 curves (alg. 7: 12M + 2 small-const
+M, branchless): one uniform vectorized formula covers generic add, doubling,
+inverses and infinity — no data-dependent control flow, which is exactly what
+the TPU/XLA execution model wants (the reference's CPU Pippenger branches per
+point; branching is the wrong shape for SIMD lanes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..fields import bn254
+from . import field_ops as F
+from . import limbs as L
+
+
+def _fq():
+    return F.fq_ctx()
+
+
+def encode_points(points) -> jax.Array:
+    """Host: list of affine (x, y) | None -> [n, 3, 16] projective Montgomery."""
+    ctx = _fq()
+    xs, ys, zs = [], [], []
+    for pt in points:
+        if pt is None:
+            xs.append(0), ys.append(1), zs.append(0)
+        else:
+            xs.append(int(pt[0])), ys.append(int(pt[1])), zs.append(1)
+    return jnp.stack([ctx.encode(xs), ctx.encode(ys), ctx.encode(zs)], axis=-2)
+
+
+def decode_points(arr) -> list:
+    """Device projective -> list of affine (x:int, y:int) | None."""
+    ctx = _fq()
+    arr = arr.reshape(-1, 3, F.NLIMBS)
+    zs = arr[:, 2]
+    zinv = F.inv(ctx, zs)
+    xs = ctx.decode(F.mont_mul(ctx, arr[:, 0], zinv))
+    ys = ctx.decode(F.mont_mul(ctx, arr[:, 1], zinv))
+    z_int = ctx.decode(zs)
+    return [None if z == 0 else (x, y) for x, y, z in zip(xs, ys, z_int)]
+
+
+def inf_point(shape=()) -> jax.Array:
+    """Projective infinity (0:1:0) broadcast to [..., 3, 16]."""
+    ctx = _fq()
+    pt = jnp.stack([ctx.zero, ctx.one_mont, ctx.zero], axis=0)
+    return jnp.broadcast_to(pt, tuple(shape) + (3, F.NLIMBS))
+
+
+def padd(p, q):
+    """Complete projective add, a=0, b=3 (RCB alg. 7). p, q: [..., 3, 16].
+
+    The 12 field multiplies are batched into TWO stacked mont_mul calls (the
+    formula has two dependency layers of muls); adds/subs are likewise stacked.
+    This matters: every field op lowers to a lax.scan over limb rounds, and
+    XLA compile time scales with scan count, so 2 big scans beat 12 small ones
+    — runtime also improves (wider batches per kernel)."""
+    ctx = _fq()
+    add = lambda a, b: F.add(ctx, a, b)       # noqa: E731
+    sub = lambda a, b: F.sub(ctx, a, b)       # noqa: E731
+    x1, y1, z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    x2, y2, z2 = q[..., 0, :], q[..., 1, :], q[..., 2, :]
+
+    # pre-sums, stacked: [x1+y1, y1+z1, x1+z1] and same for q
+    s1 = add(jnp.stack([x1, y1, x1]), jnp.stack([y1, z1, z1]))
+    s2 = add(jnp.stack([x2, y2, x2]), jnp.stack([y2, z2, z2]))
+
+    # mul layer 1: t0=x1x2, t1=y1y2, t2=z1z2, m3=(x1+y1)(x2+y2),
+    #              m4=(y1+z1)(y2+z2), m5=(x1+z1)(x2+z2)
+    la = jnp.concatenate([jnp.stack([x1, y1, z1]), s1], axis=0)
+    lb = jnp.concatenate([jnp.stack([x2, y2, z2]), s2], axis=0)
+    t0, t1, t2, m3, m4, m5 = F.mont_mul(ctx, la, lb)
+
+    # cross terms, stacked subtract: t3 = x1y2+x2y1, t4 = y1z2+y2z1, ycross = x1z2+x2z1
+    sums = add(jnp.stack([t0, t1, t0]), jnp.stack([t1, t2, t2]))
+    t3, t4, ycross = sub(jnp.stack([m3, m4, m5]), sums)
+
+    t0_3 = add(add(t0, t0), t0)               # 3 x1x2
+    # b3 = 3b = 9 multiples of t2 and ycross via stacked add chain
+    v = jnp.stack([t2, ycross])
+    v2 = add(v, v)
+    v8 = add(v2, v2)
+    v8 = add(v8, v8)
+    b3t2, b3y = add(v8, v)
+
+    z3 = add(t1, b3t2)
+    t1m = sub(t1, b3t2)
+
+    # mul layer 2: x3a=t4*b3y, x3b=t3*t1m, y3a=b3y*t0_3, y3b=t1m*z3,
+    #              z3a=t0_3*t3, z3b=z3*t4
+    la2 = jnp.stack([t4, t3, b3y, t1m, t0_3, z3])
+    lb2 = jnp.stack([b3y, t1m, t0_3, z3, t3, t4])
+    x3a, x3b, y3a, y3b, z3a, z3b = F.mont_mul(ctx, la2, lb2)
+
+    res = jnp.stack([sub(x3b, x3a), add(y3b, y3a), add(z3b, z3a)], axis=-2)
+    return res
+
+
+def pdbl(p):
+    """Doubling via the complete add (could specialize later; complete add
+    already handles it — kept for call-site clarity)."""
+    return padd(p, p)
+
+
+def pneg(p):
+    ctx = _fq()
+    return jnp.stack([p[..., 0, :], F.neg(ctx, p[..., 1, :]), p[..., 2, :]], axis=-2)
+
+
+def select_point(mask, a, b):
+    """mask ? a : b with mask shaped [...] (no point/limb axes)."""
+    return jnp.where(mask[..., None, None], a, b)
+
+
+def is_inf(p):
+    return F.is_zero(p[..., 2, :])
+
+
+def scalar_mul(p, k: int):
+    """Single-point scalar mul by host int (double-and-add, unrolled bits)."""
+    acc = inf_point(p.shape[:-2])
+    base = p
+    while k:
+        if k & 1:
+            acc = padd(acc, base)
+        k >>= 1
+        if k:
+            base = padd(base, base)
+    return acc

@@ -1,0 +1,60 @@
+"""Host-side conversion between Python ints and 16-bit limb tensors.
+
+Device convention: a 256-bit value is [..., 16] uint32, little-endian 16-bit
+limbs (each entry < 2^16). This is the wire format between the host (Python
+ints / the native C++ lib's 4x64 limbs) and device kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+NLIMBS = 16
+LIMB_BITS = 16
+LIMB_MASK = (1 << LIMB_BITS) - 1
+
+
+def ints_to_limbs16(vals) -> np.ndarray:
+    """Iterable of ints -> [n, 16] uint32 (16-bit limbs, little-endian)."""
+    vals = list(vals)
+    out = np.zeros((len(vals), NLIMBS), dtype=np.uint32)
+    for i, v in enumerate(vals):
+        v = int(v)
+        for j in range(NLIMBS):
+            out[i, j] = (v >> (LIMB_BITS * j)) & LIMB_MASK
+    return out
+
+
+def limbs16_to_ints(arr: np.ndarray) -> list[int]:
+    """[..., 16] limb array -> list of ints (leading axes flattened)."""
+    arr = np.asarray(arr, dtype=np.uint64).reshape(-1, NLIMBS)
+    return [sum(int(row[j]) << (LIMB_BITS * j) for j in range(NLIMBS)) for row in arr]
+
+
+def int_to_limbs16(v: int) -> np.ndarray:
+    return ints_to_limbs16([v])[0]
+
+
+def u64limbs_to_u16limbs(arr: np.ndarray) -> np.ndarray:
+    """[n, 4] uint64 (native lib format) -> [n, 16] uint32 16-bit limbs."""
+    arr = np.asarray(arr, dtype=np.uint64)
+    n = arr.shape[0]
+    out = np.zeros((n, NLIMBS), dtype=np.uint32)
+    for j in range(4):
+        limb = arr[:, j]
+        for k in range(4):
+            out[:, 4 * j + k] = (limb >> np.uint64(16 * k)).astype(np.uint64) & np.uint64(0xFFFF)
+    return out
+
+
+def u16limbs_to_u64limbs(arr: np.ndarray) -> np.ndarray:
+    """[n, 16] uint32 16-bit limbs -> [n, 4] uint64 (native lib format)."""
+    arr = np.asarray(arr, dtype=np.uint64)
+    n = arr.shape[0]
+    out = np.zeros((n, 4), dtype=np.uint64)
+    for j in range(4):
+        acc = np.zeros(n, dtype=np.uint64)
+        for k in range(4):
+            acc |= (arr[:, 4 * j + k] & np.uint64(0xFFFF)) << np.uint64(16 * k)
+        out[:, j] = acc
+    return out

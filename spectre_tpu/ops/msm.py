@@ -1,0 +1,180 @@
+"""Pippenger MSM over BN254 G1 on device (the north-star kernel, N2).
+
+Reference parity: halo2's CPU Pippenger (`halo2_proofs` best_multiexp, rayon-
+parallel, SURVEY.md §2b N2). That algorithm is branch-and-scatter per point —
+the wrong shape for a vector machine — so this is a ground-up redesign around
+three TPU constraints: static shapes, no random-access writes, no data-
+dependent control flow.
+
+Per window (processed under `lax` control flow so the graph stays small):
+  1. digit extraction from limb scalars (branchless bit windowing)
+  2. stable sort of point indices by bucket digit
+  3. segmented halving reduction over the sorted array: at each of log2(n)
+     levels adjacent pairs in the same bucket merge (complete projective add);
+     pairs straddling a bucket boundary emit their left element into a
+     [level, bucket] emission slot — each bucket emits at most once per level,
+     so the scatter is conflict-free (OOB indices dropped). Skew-proof: a
+     bucket with ALL n points still reduces in log2(n) levels with O(n) work,
+     unlike padded-gather schemes whose memory explodes.
+  4. bucket totals = tree-reduce of the emission array over levels
+  5. weighted bucket aggregation sum_b b*B_b via bit decomposition: for each
+     digit bit j, tree-reduce the masked buckets, then a 13-step double-and-add
+     — depth log(nbuckets) instead of a 2^c-step serial scan.
+  6. window combine: fori_loop of c doublings + add.
+
+Complete RCB addition (ops.ec) makes every step branchless; infinity is the
+identity everywhere, so masking = setting slots to (0:1:0).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import ec
+from . import field_ops as F
+
+NLIMBS = F.NLIMBS
+
+
+def _digits_traced(scalars, w, c: int):
+    """Extract window-w c-bit digits from [n, 16] 16-bit limb scalars; w may
+    be a traced int32 (used inside lax loops). Branchless across limb
+    boundaries: a digit spans at most 2 limbs for c <= 16."""
+    off = w * c
+    limb_idx = off // 16
+    shift = off % 16
+    col = jnp.take(scalars, limb_idx, axis=1)
+    nxt = jnp.take(scalars, jnp.minimum(limb_idx + 1, NLIMBS - 1), axis=1)
+    lo = col >> shift
+    hi = jnp.where(shift > 0, nxt << (16 - shift), 0)
+    hi = jnp.where(limb_idx + 1 < NLIMBS, hi, 0)
+    return ((lo | hi) & ((1 << c) - 1)).astype(jnp.int32)
+
+
+def _segmented_bucket_sums(points, digits, nbuckets: int):
+    """Sorted segmented reduction -> [nbuckets, 3, 16] bucket sums.
+
+    points: [n, 3, 16] projective Montgomery; digits: [n] int32 bucket ids
+    (0 = skip — bucket 0 has weight zero in aggregation)."""
+    n = points.shape[0]
+    order = jnp.argsort(digits, stable=True)
+    buckets = digits[order]
+    pts = points[order]
+    # pad to a power of two >= 2 with sentinel bucket id == nbuckets: sorts
+    # after every real digit, never merges with one (emissions to it are OOB
+    # and dropped), so correctness is unaffected.
+    n_pad = max(1 << ((n - 1).bit_length() if n > 1 else 1), 2)
+    if n_pad != n:
+        pts = jnp.concatenate([pts, ec.inf_point((n_pad - n,))], axis=0)
+        buckets = jnp.concatenate(
+            [buckets, jnp.full((n_pad - n,), nbuckets, dtype=buckets.dtype)])
+    n = n_pad
+    levels = n.bit_length() - 1
+
+    emissions = ec.inf_point((levels + 1, nbuckets))
+    for lvl in range(levels):
+        m = pts.shape[0]
+        left, right = pts[0::2], pts[1::2]
+        bl, br = buckets[0::2], buckets[1::2]
+        same = bl == br
+        merged = ec.padd(left, right)
+        pts = ec.select_point(same, merged, right)
+        # boundary pairs: left element is the tail of bucket bl -> emit.
+        # at most one emission per bucket per level => conflict-free scatter;
+        # non-emitting lanes target an out-of-range row and are dropped.
+        emit_idx = jnp.where(same, nbuckets, bl)
+        emissions = emissions.at[lvl, emit_idx].set(left, mode="drop")
+        buckets = br
+    # final survivor
+    emissions = emissions.at[levels, buckets[0]].set(pts[0], mode="drop")
+
+    # tree-reduce emissions over the level axis
+    acc = emissions
+    total_levels = levels + 1
+    while acc.shape[0] > 1:
+        k = acc.shape[0]
+        half = k // 2
+        merged = ec.padd(acc[:half], acc[half:2 * half])
+        acc = jnp.concatenate([merged, acc[2 * half:]], axis=0) \
+            if k % 2 else merged
+    return acc[0]
+
+
+def _aggregate_buckets(bucket_sums, c: int):
+    """sum_b b * B_b for each window via bit decomposition.
+
+    bucket_sums: [nwin, nbuckets, 3, 16] -> [nwin, 3, 16]."""
+    nwin, nbuckets = bucket_sums.shape[0], bucket_sums.shape[1]
+    idx = jnp.arange(nbuckets)
+    # [nwin, c, nbuckets, 3, 16] masked by bit j of the bucket index
+    masks = ((idx[None, :] >> jnp.arange(c)[:, None]) & 1).astype(bool)  # [c, nbuckets]
+    sel = ec.select_point(masks[None, :, :], bucket_sums[:, None],
+                          ec.inf_point((1, 1, 1)))
+    # tree-reduce over the bucket axis
+    while sel.shape[2] > 1:
+        k = sel.shape[2]
+        half = k // 2
+        merged = ec.padd(sel[:, :, :half], sel[:, :, half:2 * half])
+        sel = jnp.concatenate([merged, sel[:, :, 2 * half:]], axis=2) \
+            if k % 2 else merged
+    bit_sums = sel[:, :, 0]                      # [nwin, c, 3, 16]
+    # acc = sum_j 2^j bit_sums[:, j] by high-to-low double-and-add
+    acc = ec.inf_point((nwin,))
+    for j in range(c - 1, -1, -1):
+        acc = ec.padd(acc, acc)
+        acc = ec.padd(acc, bit_sums[:, j])
+    return acc
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def msm_windows(points, scalars, c: int):
+    """Per-window partial MSM sums: [nwin, 3, 16].
+
+    points: [n, 3, 16] projective Montgomery; scalars: [n, 16] standard-form
+    16-bit limbs. Separated from the final combine so the window axis can be
+    sharded across devices (parallel.sharded_msm all-reduces these)."""
+    nwin = (254 + c - 1) // c
+    nbuckets = 1 << c
+
+    def one_window(w):
+        d = _digits_traced(scalars, w, c)
+        return _segmented_bucket_sums(points, d, nbuckets)
+
+    bucket_sums = jax.lax.map(one_window, jnp.arange(nwin))  # [nwin, nb, 3, 16]
+    return _aggregate_buckets(bucket_sums, c)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def combine_windows(window_sums, c: int):
+    """res = sum_w 2^{cw} W_w, high window to low: c doublings + add each."""
+    nwin = window_sums.shape[0]
+
+    def body(i, acc):
+        for _ in range(c):
+            acc = ec.padd(acc, acc)
+        return ec.padd(acc, window_sums[nwin - 1 - i])
+
+    return jax.lax.fori_loop(0, nwin, body, ec.inf_point(()))
+
+
+def default_window(n: int) -> int:
+    if n >= 1 << 18:
+        return 13
+    if n >= 1 << 12:
+        return 10
+    if n >= 1 << 7:
+        return 7
+    return 4
+
+
+def msm(points, scalars, c: int | None = None):
+    """Full MSM on one device. points [n,3,16] proj Montgomery (ec.encode_points),
+    scalars [n,16] standard limbs (limbs.ints_to_limbs16). Returns [3,16]."""
+    n = points.shape[0]
+    if c is None:
+        c = default_window(n)
+    return combine_windows(msm_windows(points, scalars, c), c)

@@ -1,0 +1,108 @@
+"""Radix-2 NTT / iNTT over BN254 Fr on limb tensors (device kernel N3).
+
+Reference parity: halo2's FFT (`halo2_proofs` best_fft, SURVEY.md §2b N3),
+re-designed for XLA: iterative Cooley-Tukey with a host-precomputed bit-reversal
+permutation and per-stage twiddle tables shipped to device once per (k, omega).
+Each stage is one fully-vectorized butterfly over the whole array — no
+data-dependent control flow, shapes static per k.
+
+Coset NTTs (quotient-poly evaluation) compose this with elementwise scaling by
+a precomputed power table (see `coset_scale`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fields import bn254
+from . import field_ops as F
+from . import limbs as L
+
+R = bn254.R
+
+
+@functools.cache
+def _bitrev(logn: int) -> np.ndarray:
+    n = 1 << logn
+    idx = np.arange(n)
+    rev = np.zeros(n, dtype=np.int32)
+    for b in range(logn):
+        rev |= ((idx >> b) & 1) << (logn - 1 - b)
+    return rev
+
+
+@functools.cache
+def _stage_twiddles(logn: int, omega: int):
+    """Montgomery twiddle tables per stage: stage s has m=2^s butterflies per
+    block, twiddle_j = omega^(n/(2m) * j), j < m."""
+    ctx = F.fr_ctx()
+    n = 1 << logn
+    tables = []
+    for s in range(logn):
+        m = 1 << s
+        w = pow(omega, n // (2 * m), R)
+        powers = [1] * m
+        for j in range(1, m):
+            powers[j] = powers[j - 1] * w % R
+        tables.append(ctx.encode(powers))
+    return tables
+
+
+def ntt(a: jax.Array, omega: int) -> jax.Array:
+    """NTT of [n, 16] Montgomery limb tensor; returns evaluations in natural
+    order. omega must be a primitive n-th root of unity (host int)."""
+    ctx = F.fr_ctx()
+    n = a.shape[0]
+    logn = n.bit_length() - 1
+    assert 1 << logn == n
+    tables = _stage_twiddles(logn, omega)
+    a = a[jnp.asarray(_bitrev(logn))]
+    for s in range(logn):
+        m = 1 << s
+        tw = tables[s]                       # [m, 16]
+        blk = a.reshape(n // (2 * m), 2, m, F.NLIMBS)
+        u = blk[:, 0]                        # [n/2m, m, 16]
+        v = F.mont_mul(ctx, blk[:, 1], tw[None])
+        a = jnp.stack([F.add(ctx, u, v), F.sub(ctx, u, v)], axis=1).reshape(n, F.NLIMBS)
+    return a
+
+
+def intt(a: jax.Array, omega: int) -> jax.Array:
+    """Inverse NTT: forward with omega^{-1}, then scale by n^{-1}."""
+    ctx = F.fr_ctx()
+    n = a.shape[0]
+    res = ntt(a, pow(omega, -1, R))
+    ninv = ctx.encode([pow(n, -1, R)])[0]
+    return F.mont_mul(ctx, res, ninv[None])
+
+
+@functools.cache
+def _power_table(logn: int, g: int):
+    """[n, 16] Montgomery table of g^i (host-computed once, cached)."""
+    ctx = F.fr_ctx()
+    n = 1 << logn
+    powers = [1] * n
+    for i in range(1, n):
+        powers[i] = powers[i - 1] * g % R
+    return ctx.encode(powers)
+
+
+def coset_scale(a: jax.Array, g: int, inverse: bool = False) -> jax.Array:
+    """a_i *= g^i (or g^{-i}) — composes with ntt/intt for coset evaluation."""
+    ctx = F.fr_ctx()
+    n = a.shape[0]
+    logn = n.bit_length() - 1
+    tab = _power_table(logn, pow(g, -1, R) if inverse else g)
+    return F.mont_mul(ctx, a, tab)
+
+
+def coset_ntt(a: jax.Array, omega: int, g: int) -> jax.Array:
+    return ntt(coset_scale(a, g), omega)
+
+
+def coset_intt(a: jax.Array, omega: int, g: int) -> jax.Array:
+    return coset_scale(intt(a, omega), g, inverse=True)

@@ -1,0 +1,118 @@
+"""Batched SHA256 over u32 lanes (witness hashing kernel, N6).
+
+Reference parity: `zkevm-hashes` `generate_witnesses_sha256` + the sha2 crate
+(SURVEY.md §2b N6) — the prover hashes ~1000+ 64-byte blocks per proof (SSZ
+merkleization, signing roots, pubkey roots). Here one vectorized compression
+processes every block in the batch simultaneously; the 64 rounds run as a
+lax.scan with a rolling 16-word message-schedule window.
+
+Host-side padding helpers mirror FIPS 180-4; oracle = hashlib.sha256.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+H0 = np.array([0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+               0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19], dtype=np.uint32)
+
+K = np.array([
+    0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+    0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+    0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+    0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+    0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+    0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+    0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+    0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+    0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+    0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+    0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2], dtype=np.uint32)
+
+
+def _rotr(x, r):
+    return (x >> r) | (x << (32 - r))
+
+
+def compress(state: jax.Array, blocks: jax.Array) -> jax.Array:
+    """One SHA256 compression: state [n, 8] u32, blocks [n, 16] u32 -> [n, 8]."""
+    a, b, c, d, e, f, g, h = [state[:, i] for i in range(8)]
+    win = jnp.moveaxis(blocks, 1, 0)  # [16, n] rolling schedule window
+
+    def rnd(carry, kt):
+        a, b, c, d, e, f, g, h, win = carry
+        wt = win[0]
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + kt + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        sig0 = _rotr(win[1], 7) ^ _rotr(win[1], 18) ^ (win[1] >> 3)
+        sig1 = _rotr(win[14], 17) ^ _rotr(win[14], 19) ^ (win[14] >> 10)
+        nxt = sig1 + win[9] + sig0 + win[0]
+        win = jnp.concatenate([win[1:], nxt[None]], axis=0)
+        return (t1 + t2, a, b, c, d + t1, e, f, g, win), None
+
+    carry = (a, b, c, d, e, f, g, h, win)
+    carry, _ = jax.lax.scan(rnd, carry, jnp.asarray(K))
+    na, nb, nc, nd, ne, nf, ng, nh = carry[:8]
+    return state + jnp.stack([na, nb, nc, nd, ne, nf, ng, nh], axis=1)
+
+
+def sha256_blocks(blocks: jax.Array) -> jax.Array:
+    """Hash [n, nblocks, 16] u32 pre-padded messages -> [n, 8] digests.
+
+    nblocks is static; chaining over blocks is a host loop (small)."""
+    n = blocks.shape[0]
+    state = jnp.broadcast_to(jnp.asarray(H0), (n, 8))
+    for i in range(blocks.shape[1]):
+        state = compress(state, blocks[:, i])
+    return state
+
+
+def hash_pairs(left: jax.Array, right: jax.Array) -> jax.Array:
+    """SSZ merkle node hash: sha256(left || right) of 32-byte nodes as [n, 8]
+    u32 words -> [n, 8]. 64-byte message = 1 data block + 1 constant pad block."""
+    n = left.shape[0]
+    block1 = jnp.concatenate([left, right], axis=1)
+    pad = np.zeros(16, dtype=np.uint32)
+    pad[0] = 0x80000000
+    pad[15] = 512  # message length in bits
+    state = compress(jnp.broadcast_to(jnp.asarray(H0), (n, 8)), block1)
+    return compress(state, jnp.broadcast_to(jnp.asarray(pad), (n, 16)))
+
+
+# ---------------------------------------------------------------------------
+# host-side packing helpers
+# ---------------------------------------------------------------------------
+
+def pad_message(msg: bytes) -> np.ndarray:
+    """FIPS 180-4 padding -> [nblocks, 16] uint32 (big-endian words)."""
+    ln = len(msg)
+    msg = msg + b"\x80"
+    while (len(msg) % 64) != 56:
+        msg += b"\x00"
+    msg += (8 * ln).to_bytes(8, "big")
+    arr = np.frombuffer(msg, dtype=">u4").astype(np.uint32)
+    return arr.reshape(-1, 16)
+
+
+def bytes32_to_words(b: bytes) -> np.ndarray:
+    assert len(b) == 32
+    return np.frombuffer(b, dtype=">u4").astype(np.uint32)
+
+
+def words_to_bytes32(w) -> bytes:
+    return np.asarray(w, dtype=np.uint32).astype(">u4").tobytes()
+
+
+def sha256_many(msgs: list[bytes]) -> list[bytes]:
+    """Batched hash of equal-length byte messages (host convenience)."""
+    assert msgs and all(len(m) == len(msgs[0]) for m in msgs)
+    blocks = np.stack([pad_message(m) for m in msgs])  # [n, nb, 16]
+    out = sha256_blocks(jnp.asarray(blocks))
+    return [words_to_bytes32(row) for row in np.asarray(out)]

@@ -1,0 +1,12 @@
+"""Distributed execution: device meshes, sharded MSM/NTT, batched proving.
+
+The reference is a single-process prover (rayon shared-memory, SURVEY.md §2c);
+the TPU-native equivalents:
+  (a) intra-proof sharding: one MSM/NTT sharded over chips via shard_map
+      (tensor-parallel analog) — partial bucket/window sums all-reduced over ICI
+  (b) inter-proof batching: vmap/pmap over independent proofs (data-parallel)
+  (c) pipeline: witness gen (host) overlapped with device commit phases
+"""
+
+from .mesh import make_mesh, default_mesh  # noqa: F401
+from .sharded_msm import sharded_msm  # noqa: F401

@@ -1,0 +1,33 @@
+"""Device mesh construction for multi-chip proving.
+
+Axes:
+  "data" — shards MSM points / NTT rows / witness columns (the wide axis)
+  "win"  — shards Pippenger windows (small, independent work units)
+
+On a v4-8 (8 chips) the default is a 4x2 (data, win) mesh; single-chip and
+virtual-CPU configurations collapse gracefully.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: int | None = None, data_axis: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    if data_axis is None:
+        # prefer a 2D split when we have >= 4 devices
+        data_axis = n // 2 if n >= 4 else n
+    win_axis = n // data_axis
+    assert data_axis * win_axis == n, (data_axis, n)
+    arr = np.array(devs).reshape(data_axis, win_axis)
+    return Mesh(arr, axis_names=("data", "win"))
+
+
+def default_mesh() -> Mesh:
+    return make_mesh()

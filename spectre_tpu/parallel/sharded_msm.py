@@ -1,0 +1,81 @@
+"""MSM sharded over a device mesh (tensor-parallel analog for the prover).
+
+Decomposition (SURVEY.md §2c(a)): points are sharded along the mesh "data"
+axis — each shard computes per-window partial sums over its local points —
+and Pippenger windows are sharded along the "win" axis. Partial window sums
+are combined with an all-gather over "data" followed by a local projective
+tree-fold (EC addition is not a psum-able monoid over limb tensors, so the
+reduction is an explicit gather+fold riding ICI), then windows are gathered
+over "win" and the final double-and-add combine runs replicated.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..ops import ec, msm as MSM
+
+
+def _fold_points(stacked):
+    """Tree-fold [k, nwin, 3, 16] partial sums -> [nwin, 3, 16]."""
+    acc = stacked
+    while acc.shape[0] > 1:
+        k = acc.shape[0]
+        half = k // 2
+        merged = ec.padd(acc[:half], acc[half:2 * half])
+        acc = jnp.concatenate([merged, acc[2 * half:]], axis=0) if k % 2 else merged
+    return acc[0]
+
+
+def sharded_msm(points, scalars, c: int, mesh: Mesh):
+    """MSM over a ("data", "win") mesh.
+
+    points [n, 3, 16] projective Montgomery, scalars [n, 16] standard limbs;
+    n must divide evenly by the data-axis size. Returns a replicated [3, 16]
+    projective result."""
+    nwin = (254 + c - 1) // c
+    n_win_shards = mesh.shape["win"]
+    # pad the window count so it shards evenly; extra windows read digit bits
+    # beyond 254 which are always zero -> contribute infinity, harmless.
+    nwin_padded = ((nwin + n_win_shards - 1) // n_win_shards) * n_win_shards
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("data", None, None), P("data", None)),
+        out_specs=P(None, None, None),
+        check_vma=False,  # scan carries start as unvarying constants (vma mismatch)
+    )
+    def windows_phase(pts, sc):
+        widx = jax.lax.axis_index("win")
+        nloc = nwin_padded // n_win_shards
+
+        def one_window(i):
+            w = widx * nloc + i
+            d = MSM._digits_traced(sc, w, c)
+            # mask digits for windows beyond the real count
+            d = jnp.where(w < nwin, d, 0)
+            return MSM._segmented_bucket_sums(pts, d, 1 << c)
+
+        bucket_sums = jax.lax.map(one_window, jnp.arange(nloc))
+        local = MSM._aggregate_buckets(bucket_sums, c)     # [nloc, 3, 16]
+        # combine partials across the data axis: gather + projective fold
+        gathered = jax.lax.all_gather(local, "data")        # [ndata, nloc, 3, 16]
+        folded = _fold_points(gathered)                     # [nloc, 3, 16]
+        # gather window shards: [nwin_shards, nloc, 3, 16] -> flatten
+        wins = jax.lax.all_gather(folded, "win")
+        return wins.reshape(nwin_padded, 3, ec.F.NLIMBS)
+
+    window_sums = windows_phase(points, scalars)[:nwin]
+    return MSM.combine_windows(window_sums, c)
+
+
+def shard_points(points, scalars, mesh: Mesh):
+    """Place host arrays onto the mesh with data-axis sharding."""
+    ps = NamedSharding(mesh, P("data", None, None))
+    ss = NamedSharding(mesh, P("data", None))
+    return jax.device_put(points, ps), jax.device_put(scalars, ss)

@@ -1,0 +1,16 @@
+"""The proving system: PLONKish arithmetization with KZG/SHPLONK on BN254.
+
+Protocol shape follows halo2 (PSE) — vertical flex gate, chunked permutation
+grand products, permutation-based range lookups, vanishing argument over a 4n
+coset-extended domain, BDFG20 (SHPLONK) multiopen — re-implemented from the
+protocol math, with all bulk polynomial work routed through a pluggable
+backend (native C++ on host, JAX limb kernels on TPU).
+
+Reference parity map (SURVEY.md §1 L0): `halo2_proofs` keygen/prover/verifier,
+`snark-verifier` SHPLONK — here plonk/{keygen,prover,verifier,kzg}.py.
+"""
+
+from .backend import get_backend, CpuBackend  # noqa: F401
+from .domain import Domain  # noqa: F401
+from .srs import SRS  # noqa: F401
+from .transcript import Blake2bTranscript  # noqa: F401

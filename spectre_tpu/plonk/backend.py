@@ -1,0 +1,162 @@
+"""Pluggable bulk-math backend for the prover: cpu (native C++) or tpu (JAX).
+
+All prover-side polynomial data lives as numpy [n, 4] uint64 limb arrays in
+standard form (little-endian 64-bit limbs). The backend supplies the heavy
+ops: batched field arithmetic, NTTs, MSMs. The reference's `--backend`
+selection point (BASELINE.json north star: `ProverBackend` trait) is this
+class.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..fields import bn254
+from ..native import host
+
+R = bn254.R
+
+
+def to_arr(vals) -> np.ndarray:
+    return host.ints_to_limbs([int(v) % R for v in vals])
+
+
+def arr_to_ints(arr) -> list[int]:
+    return host.limbs_to_ints(arr)
+
+
+def zeros(n: int) -> np.ndarray:
+    return np.zeros((n, 4), dtype=np.uint64)
+
+
+class CpuBackend:
+    """Native C++ single-host backend (the measured baseline)."""
+
+    name = "cpu"
+
+    # -- batched Fr ops on [n,4] arrays --
+    def mul(self, a, b):
+        return host.fp_mul_batch(host.FR, a, b)
+
+    def add(self, a, b):
+        return host.fp_add_batch(host.FR, a, b)
+
+    def sub(self, a, b):
+        return host.fp_sub_batch(host.FR, a, b)
+
+    def inv(self, a):
+        return host.fp_inv_batch(host.FR, a)
+
+    def scale(self, a, s: int):
+        return host.fp_scale_batch(host.FR, a, s)
+
+    def powers(self, x: int, n: int):
+        return host.fp_powers(host.FR, x, n)
+
+    def prefix_prod(self, a):
+        return host.fp_prefix_prod(host.FR, a)
+
+    # -- NTT (in place on a copy; returns new array) --
+    def ntt(self, coeffs, omega: int):
+        data = np.array(coeffs, dtype=np.uint64)
+        return host.fr_ntt(data, omega)
+
+    def intt(self, evals, omega: int):
+        n = evals.shape[0]
+        data = np.array(evals, dtype=np.uint64)
+        host.fr_ntt(data, pow(omega, -1, R))
+        return host.fp_scale_batch(host.FR, data, pow(n, -1, R))
+
+    # -- MSM: points [m, 8] u64 affine standard, scalars [m, 4] --
+    def msm(self, points, scalars):
+        m = min(points.shape[0], scalars.shape[0])
+        return host.g1_msm(points[:m], scalars[:m])
+
+
+class TpuBackend(CpuBackend):
+    """JAX backend: MSM/NTT ride the device kernels; small ops stay native.
+
+    Inherits the native implementations and overrides the ops where the device
+    wins. Conversions to 16-bit limb tensors happen at the boundary."""
+
+    name = "tpu"
+
+    def __init__(self):
+        import jax  # noqa: F401  (fail fast if jax unusable)
+        from ..ops import limbs as L16  # noqa: F401
+
+    def msm(self, points, scalars):
+        import jax.numpy as jnp
+
+        from ..ops import ec, field_ops as F, limbs as L16, msm as MSM
+
+        m = min(points.shape[0], scalars.shape[0])
+        points, scalars = points[:m], scalars[:m]
+        ctxq = F.fq_ctx()
+        x16 = L16.u64limbs_to_u16limbs(points[:, :4])
+        y16 = L16.u64limbs_to_u16limbs(points[:, 4:])
+        import jax
+        to_mont = jax.jit(lambda v: F.to_mont(ctxq, v))
+        xm, ym = to_mont(jnp.asarray(x16)), to_mont(jnp.asarray(y16))
+        inf_mask = jnp.asarray(
+            (np.asarray(x16).sum(1) == 0) & (np.asarray(y16).sum(1) == 0))[:, None]
+        one = jnp.broadcast_to(jnp.asarray(ctxq.one_mont), (m, F.NLIMBS))
+        # infinity must be the RCB identity (0:1:0) — (0:0:0) is absorbing
+        ym = jnp.where(inf_mask, one, ym)
+        z = jnp.where(inf_mask, 0, one)
+        pts = jnp.stack([xm, ym, z], axis=1)
+        sc16 = jnp.asarray(L16.u64limbs_to_u16limbs(scalars))
+        res = MSM.msm(pts, sc16)
+        out = ec.decode_points(res[None])[0]
+        return out
+
+    def ntt(self, coeffs, omega: int):
+        import jax.numpy as jnp
+
+        from ..ops import field_ops as F, limbs as L16, ntt as NTT
+
+        ctx = F.fr_ctx()
+        mont = _u64_std_to_mont16(coeffs)
+        out = NTT.ntt(jnp.asarray(mont), omega)
+        return _mont16_to_u64_std(np.asarray(out))
+
+    def intt(self, evals, omega: int):
+        import jax.numpy as jnp
+
+        from ..ops import field_ops as F, limbs as L16, ntt as NTT
+
+        mont = _u64_std_to_mont16(evals)
+        out = NTT.intt(jnp.asarray(mont), omega)
+        return _mont16_to_u64_std(np.asarray(out))
+
+
+def _u64_std_to_mont16(arr):
+    """[n,4] u64 standard -> [n,16] u32 Montgomery, via device to_mont."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import field_ops as F, limbs as L16
+
+    ctx = F.fr_ctx()
+    std16 = L16.u64limbs_to_u16limbs(arr)
+    return jax.jit(lambda v: F.to_mont(ctx, v))(jnp.asarray(std16))
+
+
+def _mont16_to_u64_std(arr):
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import field_ops as F, limbs as L16
+
+    ctx = F.fr_ctx()
+    std16 = jax.jit(lambda v: F.from_mont(ctx, v))(jnp.asarray(arr))
+    return L16.u16limbs_to_u64limbs(np.asarray(std16))
+
+
+_backends = {}
+
+
+def get_backend(name: str = "cpu"):
+    if name not in _backends:
+        _backends[name] = CpuBackend() if name == "cpu" else TpuBackend()
+    return _backends[name]

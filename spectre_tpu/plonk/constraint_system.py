@@ -1,0 +1,201 @@
+"""PLONKish constraint system: the fixed arithmetization of spectre_tpu.
+
+One universal gate (halo2-lib's "vertical" flex gate, SURVEY.md L2):
+    q[i] * (a[i] + a[i+1] * a[i+2] - a[i+3]) = 0
+per gate-advice column, plus copy constraints (chunked permutation argument),
+plus a range-lookup argument binding designated lookup-advice columns to the
+table column [0, 2^lookup_bits).
+
+Column order (global permutation indexing):
+    [gate advice][lookup advice][fixed][instance]
+
+ZK: the last ZK_ROWS+1 rows are reserved (blinding + "last" row); the builder
+may only use rows < usable_rows(k) and must keep gates off the final 3 usable
+rows (the gate reads rotations +1..+3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..fields import bn254
+from .domain import DELTA
+
+R = bn254.R
+
+ZK_ROWS = 5
+PERM_CHUNK = 2  # columns per permutation grand-product (degree 4 budget)
+
+
+@dataclass(frozen=True)
+class CircuitConfig:
+    """Circuit shape — the pinning payload (reference: `Eth2ConfigPinning`
+    {k, num_advice, lookup_bits, ...}, `util/circuit.rs:55-78`)."""
+
+    k: int
+    num_advice: int
+    num_lookup_advice: int
+    num_fixed: int
+    lookup_bits: int
+    num_instance: int = 1
+
+    @property
+    def n(self) -> int:
+        return 1 << self.k
+
+    @property
+    def usable_rows(self) -> int:
+        return self.n - ZK_ROWS - 1
+
+    @property
+    def last_row(self) -> int:
+        return self.usable_rows  # l_last index
+
+    @property
+    def num_perm_columns(self) -> int:
+        return self.num_advice + self.num_lookup_advice + self.num_fixed + self.num_instance
+
+    @property
+    def num_perm_chunks(self) -> int:
+        return (self.num_perm_columns + PERM_CHUNK - 1) // PERM_CHUNK
+
+    def col_gate_advice(self, j):
+        return j
+
+    def col_lookup_advice(self, j):
+        return self.num_advice + j
+
+    def col_fixed(self, j):
+        return self.num_advice + self.num_lookup_advice + j
+
+    def col_instance(self, j):
+        return self.num_advice + self.num_lookup_advice + self.num_fixed + j
+
+    def validate(self):
+        assert self.lookup_bits < self.k, "table must fit the usable rows"
+        assert (1 << self.lookup_bits) <= self.usable_rows
+        assert self.num_instance >= 1
+
+
+@dataclass
+class Assignment:
+    """Witness-side circuit assignment (values as python-int lists).
+
+    copies: list of ((col_a, row_a), (col_b, row_b)) equality constraints,
+    using the global column indexing above."""
+
+    config: CircuitConfig
+    advice: list            # [num_advice][n] ints
+    lookup_advice: list     # [num_lookup_advice][n] ints
+    fixed: list             # [num_fixed][n] ints
+    selectors: list         # [num_advice][n] 0/1 ints
+    instances: list         # [num_instance][<=usable] ints
+    copies: list = field(default_factory=list)
+
+    def instance_column(self, j) -> list:
+        col = [0] * self.config.n
+        for i, v in enumerate(self.instances[j]):
+            col[i] = int(v) % R
+        return col
+
+
+def table_column(cfg: CircuitConfig) -> list:
+    """The range table fixed polynomial: 0..2^lookup_bits-1, padded by zeros
+    (zero is a table member, so padding rows remain valid table entries)."""
+    vals = list(range(1 << cfg.lookup_bits))
+    vals += [0] * (cfg.n - len(vals))
+    return vals
+
+
+# ---------------------------------------------------------------------------
+# permutation helpers
+# ---------------------------------------------------------------------------
+
+def build_sigma(cfg: CircuitConfig, copies) -> list[list[int]]:
+    """Union copy pairs into cycles; return sigma value columns:
+    sigma_j[i] = delta^{j'} * omega^{i'} where (j', i') = sigma(j, i)."""
+    from .domain import Domain
+
+    n = cfg.n
+    m = cfg.num_perm_columns
+    parent = {}
+
+    def find(x):
+        while parent.get(x, x) != x:
+            parent[x] = parent.get(parent[x], parent[x])
+            x = parent[x]
+        return x
+
+    def union(a, b):
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+
+    for (ca, ra), (cb, rb) in copies:
+        assert 0 <= ca < m and 0 <= cb < m, "copy column out of range"
+        assert ra < cfg.usable_rows and rb < cfg.usable_rows, \
+            "copy constraint in blinding rows"
+        union((ca, ra), (cb, rb))
+
+    # group cycle members
+    cycles: dict = {}
+    for (ca, ra), (cb, rb) in copies:
+        for cell in ((ca, ra), (cb, rb)):
+            root = find(cell)
+            cycles.setdefault(root, set()).add(cell)
+
+    # identity mapping, then rotate each cycle
+    mapping = {}
+    for members in cycles.values():
+        ordered = sorted(members)
+        for idx, cell in enumerate(ordered):
+            mapping[cell] = ordered[(idx + 1) % len(ordered)]
+
+    dom = Domain(cfg.k)
+    omega_pows = [1] * n
+    for i in range(1, n):
+        omega_pows[i] = omega_pows[i - 1] * dom.omega % R
+    delta_pows = [pow(DELTA, j, R) for j in range(m)]
+
+    sigma = []
+    for j in range(m):
+        col = [0] * n
+        for i in range(n):
+            jp, ip = mapping.get((j, i), (j, i))
+            col[i] = delta_pows[jp] * omega_pows[ip] % R
+        sigma.append(col)
+    return sigma
+
+
+def permute_lookup(cfg: CircuitConfig, a_vals: list, t_vals: list):
+    """halo2-style (A', T') for one lookup argument over the active rows.
+
+    A' = sorted A; T' = permutation of T aligning first occurrences:
+    A'[i] == A'[i-1] or A'[i] == T'[i]."""
+    u = cfg.usable_rows
+    a_active = [int(v) % R for v in a_vals[:u]]
+    t_active = [int(v) % R for v in t_vals[:u]]
+    a_sorted = sorted(a_active)
+    t_remaining = {}
+    for v in t_active:
+        t_remaining[v] = t_remaining.get(v, 0) + 1
+    t_prime = [None] * u
+    # place required first-occurrences
+    for i, v in enumerate(a_sorted):
+        if i == 0 or v != a_sorted[i - 1]:
+            assert t_remaining.get(v, 0) > 0, f"lookup value {v} not in table"
+            t_remaining[v] -= 1
+            t_prime[i] = v
+    # fill the rest with unused table values
+    leftovers = []
+    for v, cnt in t_remaining.items():
+        leftovers.extend([v] * cnt)
+    it = iter(leftovers)
+    for i in range(u):
+        if t_prime[i] is None:
+            t_prime[i] = next(it)
+    # blinding tail: arbitrary (deactivated rows)
+    pad = cfg.n - u
+    return a_sorted + [0] * pad, t_prime + [0] * pad

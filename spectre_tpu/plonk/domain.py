@@ -1,0 +1,121 @@
+"""Evaluation domains for the vanishing argument.
+
+Mirrors halo2's EvaluationDomain (SURVEY.md L0): a 2^k multiplicative subgroup
+for witness columns plus a 4x coset-extended domain for quotient evaluation
+(max constraint degree 4: gate q*(a + b*c - d), permutation chunks of 2,
+lookup product update).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..fields import bn254
+from ..native import host
+from . import backend as B
+
+R = bn254.R
+
+# max constraint degree supported -> extension factor
+EXTENSION = 4
+
+# coset generator for the extended domain (halo2 uses the field's
+# multiplicative generator); zeta-shifted so (g*omega_ext^i)^n never hits the
+# vanishing roots
+COSET_GEN = bn254.FR_GENERATOR  # 7
+
+# delta for permutation column cosets: generator of the 2^28-torsion complement,
+# delta^j * <omega> are disjoint cosets for distinct j < number of columns
+DELTA = pow(bn254.FR_GENERATOR, 1 << bn254.FR_S, R)
+
+
+@functools.cache
+def get_domain(k: int) -> "Domain":
+    return Domain(k)
+
+
+class Domain:
+    def __init__(self, k: int):
+        assert k + 2 <= bn254.FR_S
+        self.k = k
+        self.n = 1 << k
+        self.omega = bn254.fr_root_of_unity(k)
+        self.omega_inv = pow(self.omega, -1, R)
+        self.k_ext = k + 2  # EXTENSION = 4
+        self.n_ext = 1 << self.k_ext
+        self.omega_ext = bn254.fr_root_of_unity(self.k_ext)
+        assert pow(self.omega_ext, EXTENSION, R) == self.omega
+
+    # -- polynomial transforms ([m,4] u64 standard-form limb arrays) --
+    def lagrange_to_coeff(self, evals, bk=None):
+        bk = bk or B.get_backend()
+        return bk.intt(evals, self.omega)
+
+    def coeff_to_lagrange(self, coeffs, bk=None):
+        bk = bk or B.get_backend()
+        return bk.ntt(coeffs, self.omega)
+
+    def coeff_to_extended(self, coeffs, bk=None):
+        """Evaluate degree <n poly on the coset g*<omega_ext> (size 4n)."""
+        bk = bk or B.get_backend()
+        padded = np.zeros((self.n_ext, 4), dtype=np.uint64)
+        padded[:coeffs.shape[0]] = coeffs
+        # scale by coset powers then NTT
+        powers = bk.powers(COSET_GEN, self.n_ext)
+        return bk.ntt(bk.mul(padded, powers), self.omega_ext)
+
+    def extended_to_coeff(self, evals, bk=None):
+        bk = bk or B.get_backend()
+        coeffs = bk.intt(evals, self.omega_ext)
+        powers = bk.powers(pow(COSET_GEN, -1, R), self.n_ext)
+        return bk.mul(coeffs, powers)
+
+    # -- closed-form helper evaluations --
+    def vanishing_on_extended(self) -> np.ndarray:
+        """(g*omega_ext^i)^n - 1 on the extended coset, [4n, 4]."""
+        gn = pow(COSET_GEN, self.n, R)
+        wn = pow(self.omega_ext, self.n, R)  # order-4 root
+        vals = [(gn * pow(wn, i, R) - 1) % R for i in range(EXTENSION)]
+        out = [vals[i % EXTENSION] for i in range(self.n_ext)]
+        return B.to_arr(out)
+
+    def vanishing_inv_on_extended(self) -> np.ndarray:
+        bk = B.get_backend()
+        return bk.inv(self.vanishing_on_extended())
+
+    def evaluate_vanishing(self, x: int) -> int:
+        return (pow(x, self.n, R) - 1) % R
+
+    def lagrange_evals(self, x: int, rows) -> dict[int, int]:
+        """L_i(x) = omega^i (x^n - 1) / (n (x - omega^i)) for given rows.
+
+        Handles x on the domain itself (the closed form has a removable pole):
+        L_i(omega^j) = [i == j]."""
+        zx = self.evaluate_vanishing(x)
+        out = {}
+        ninv = pow(self.n, -1, R)
+        for i in rows:
+            wi = pow(self.omega, i, R)
+            if (x - wi) % R == 0:
+                out[i] = 1
+            elif zx == 0:
+                out[i] = 0  # x is a different domain point
+            else:
+                out[i] = wi * zx % R * pow((x - wi) % R, -1, R) % R * ninv % R
+        return out
+
+    def l0_lagrange(self) -> np.ndarray:
+        """L_0 evaluations on the base domain = [1, 0, 0, ...]."""
+        out = np.zeros((self.n, 4), dtype=np.uint64)
+        out[0, 0] = 1
+        return out
+
+    def rotate(self, evals: np.ndarray, by: int) -> np.ndarray:
+        """evals of p(omega^by * X) from evals of p: index shift."""
+        return np.roll(evals, -by, axis=0)
+
+    def rotate_extended(self, evals: np.ndarray, by: int) -> np.ndarray:
+        """On the 4n coset: rotation by omega (base) = 4 steps of omega_ext."""
+        return np.roll(evals, -by * EXTENSION, axis=0)

@@ -1,0 +1,136 @@
+"""Key generation: fixed-poly commitments, permutation sigmas, query plan.
+
+Reference parity: halo2 keygen_vk/keygen_pk via `AppCircuit::create_pk`
+(`util/circuit.rs:119-137`). The query plan (which poly is opened at which
+rotations) is the shared contract between prover and verifier.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..fields import bn254
+from . import backend as B
+from .constraint_system import Assignment, CircuitConfig, build_sigma, table_column
+from .domain import Domain
+from .srs import SRS
+from . import kzg
+
+R = bn254.R
+
+# rotation tag for the "last usable row" query used by permutation chunk links
+ROT_LAST = "last"
+
+
+@dataclass
+class VerifyingKey:
+    config: CircuitConfig
+    selector_commits: list
+    fixed_commits: list
+    sigma_commits: list
+    table_commit: object
+
+    @property
+    def domain(self) -> Domain:
+        from .domain import get_domain
+        return get_domain(self.config.k)
+
+    def digest(self) -> bytes:
+        h = hashlib.blake2b(digest_size=32)
+        cfg = self.config
+        h.update(repr((cfg.k, cfg.num_advice, cfg.num_lookup_advice, cfg.num_fixed,
+                       cfg.lookup_bits, cfg.num_instance)).encode())
+        for pt in (self.selector_commits + self.fixed_commits
+                   + self.sigma_commits + [self.table_commit]):
+            h.update(bn254.g1_to_bytes(pt))
+        return h.digest()
+
+    def query_plan(self):
+        """Ordered (key, rotation) pairs — the eval section of the proof."""
+        cfg = self.config
+        plan = []
+        for j in range(cfg.num_advice):
+            for rot in (0, 1, 2, 3):
+                plan.append((("adv", j), rot))
+        for j in range(cfg.num_lookup_advice):
+            plan.append((("ladv", j), 0))
+            plan.append((("pA", j), 0))
+            plan.append((("pA", j), -1))
+            plan.append((("pT", j), 0))
+            plan.append((("lz", j), 0))
+            plan.append((("lz", j), 1))
+        for c in range(cfg.num_perm_chunks):
+            plan.append((("pz", c), 0))
+            plan.append((("pz", c), 1))
+            if c + 1 < cfg.num_perm_chunks:
+                plan.append((("pz", c), ROT_LAST))
+        for j in range(cfg.num_advice):
+            plan.append((("q", j), 0))
+        for j in range(cfg.num_fixed):
+            plan.append((("fix", j), 0))
+        for j in range(cfg.num_perm_columns):
+            plan.append((("sig", j), 0))
+        plan.append((("tab", 0), 0))
+        for i in range(3):
+            plan.append((("h", i), 0))
+        return plan
+
+    def rotation_point(self, x: int, rot) -> int:
+        dom = self.domain
+        if rot == ROT_LAST:
+            return pow(dom.omega, self.config.last_row, R) * x % R
+        if rot < 0:
+            return pow(dom.omega_inv, -rot, R) * x % R
+        return pow(dom.omega, rot, R) * x % R
+
+
+@dataclass
+class ProvingKey:
+    vk: VerifyingKey
+    selector_polys: list      # coefficient form [n,4] arrays
+    fixed_polys: list
+    sigma_polys: list
+    table_poly: np.ndarray
+    # lagrange (value) forms kept for prover-side products
+    selector_values: list
+    fixed_values: list
+    sigma_values: list        # int lists
+    table_values: list
+
+
+def keygen(srs: SRS, cfg: CircuitConfig, fixed_columns: list, selectors: list,
+           copies: list, bk=None) -> ProvingKey:
+    """Generate pk/vk from the circuit's fixed content.
+
+    fixed_columns: [num_fixed][n] ints; selectors: [num_advice][n] 0/1;
+    copies: global copy-constraint pairs."""
+    bk = bk or B.get_backend()
+    cfg.validate()
+    dom = Domain(cfg.k)
+    assert srs.n >= cfg.n, "SRS too small for circuit"
+
+    sel_vals = [list(map(int, s)) for s in selectors]
+    fix_vals = [list(map(int, f)) for f in fixed_columns]
+    tab_vals = table_column(cfg)
+    sigma_vals = build_sigma(cfg, copies)
+
+    def to_poly(vals):
+        return dom.lagrange_to_coeff(B.to_arr(vals), bk)
+
+    sel_polys = [to_poly(v) for v in sel_vals]
+    fix_polys = [to_poly(v) for v in fix_vals]
+    sig_polys = [to_poly(v) for v in sigma_vals]
+    tab_poly = to_poly(tab_vals)
+
+    vk = VerifyingKey(
+        config=cfg,
+        selector_commits=[kzg.commit(srs, p, bk) for p in sel_polys],
+        fixed_commits=[kzg.commit(srs, p, bk) for p in fix_polys],
+        sigma_commits=[kzg.commit(srs, p, bk) for p in sig_polys],
+        table_commit=kzg.commit(srs, tab_poly, bk),
+    )
+    return ProvingKey(vk, sel_polys, fix_polys, sig_polys, tab_poly,
+                      sel_vals, fix_vals, sigma_vals, tab_vals)

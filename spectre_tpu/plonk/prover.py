@@ -1,0 +1,281 @@
+"""The prover: witness commitments -> grand products -> quotient -> multiopen.
+
+Reference parity: halo2's create_proof (`gen_snark_shplonk` path,
+`util/circuit.rs:163-180`, SURVEY.md §3.2 step 3 — "this is where the TPU
+backend plugs in"). All bulk math goes through the backend (MSM commitments,
+NTTs, pointwise quotient evaluation); transcript and control flow stay on host.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+import numpy as np
+
+from ..fields import bn254
+from ..native import host
+from . import backend as B, kzg
+from .constraint_system import Assignment, PERM_CHUNK, permute_lookup
+from .domain import DELTA, Domain
+from .expressions import all_expressions, perm_column_keys
+from .keygen import ProvingKey, ROT_LAST
+from .srs import SRS
+from .transcript import Blake2bTranscript
+
+R = bn254.R
+
+
+class _ArrayCtx:
+    """Prover-side expression context over extended-domain arrays."""
+
+    def __init__(self, cfg, dom: Domain, bk, ext_cache: dict):
+        self._cfg = cfg
+        self._dom = dom
+        self._bk = bk
+        self._ext = ext_cache
+        # X on the extended coset: g * omega_ext^i
+        from .domain import COSET_GEN
+        xs = bk.powers(dom.omega_ext, dom.n_ext)
+        self.x_col = bk.scale(xs, COSET_GEN)
+        self.l0 = None      # filled by prover
+        self.llast = None
+        self.lblind = None
+
+    def var(self, key, rot):
+        arr = self._ext[key]
+        if rot == 0:
+            return arr
+        if rot == ROT_LAST:
+            return self._dom.rotate_extended(arr, self._cfg.last_row)
+        return self._dom.rotate_extended(arr, rot)
+
+    def mul(self, a, b):
+        return self._bk.mul(a, b)
+
+    def add(self, a, b):
+        return self._bk.add(a, b)
+
+    def sub(self, a, b):
+        return self._bk.sub(a, b)
+
+    def scale(self, a, s):
+        return self._bk.scale(a, s % R)
+
+    def add_const(self, a, s):
+        return self._bk.add(a, B.to_arr([s % R] * a.shape[0]))
+
+    def const(self, s):
+        return B.to_arr([s % R] * self._dom.n_ext)
+
+
+def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
+          bk=None, transcript=None) -> bytes:
+    bk = bk or B.get_backend()
+    cfg = pk.vk.config
+    dom = pk.vk.domain
+    n, u = cfg.n, cfg.usable_rows
+    tr = transcript or Blake2bTranscript()
+
+    # --- bind statement: vk digest + instances ---
+    tr._absorb_bytes(pk.vk.digest())
+    for col in assignment.instances:
+        for v in col:
+            tr.common_scalar(int(v) % R)
+
+    # --- 1. blind + commit advice and lookup-advice columns ---
+    def blind(vals):
+        out = [int(v) % R for v in vals]
+        for i in range(u, n):
+            out[i] = secrets.randbelow(R)
+        return out
+
+    adv_vals = [blind(v) for v in assignment.advice]
+    ladv_vals = [blind(v) for v in assignment.lookup_advice]
+    inst_vals = [assignment.instance_column(j) for j in range(cfg.num_instance)]
+
+    polys: dict = {}      # key -> coefficient form
+    values: dict = {}     # key -> int list (lagrange values)
+
+    def commit_col(key, vals):
+        values[key] = vals
+        coeffs = dom.lagrange_to_coeff(B.to_arr(vals), bk)
+        polys[key] = coeffs
+        pt = kzg.commit(srs, coeffs, bk)
+        tr.write_point(pt)
+
+    for j, v in enumerate(adv_vals):
+        commit_col(("adv", j), v)
+    for j, v in enumerate(ladv_vals):
+        commit_col(("ladv", j), v)
+
+    # --- 2. lookup permuted columns ---
+    for j in range(cfg.num_lookup_advice):
+        pa, pt_col = permute_lookup(cfg, ladv_vals[j], pk.table_values)
+        commit_col(("pA", j), pa)
+        commit_col(("pT", j), pt_col)
+
+    beta = tr.challenge()
+    gamma = tr.challenge()
+
+    # --- 3. permutation grand products (chunk-linked) ---
+    col_keys = perm_column_keys(cfg)
+    omega_pows = bk.powers(dom.omega, n)
+
+    def col_values(key):
+        kind, j = key
+        if kind == "adv":
+            return adv_vals[j]
+        if kind == "ladv":
+            return ladv_vals[j]
+        if kind == "fix":
+            return pk.fixed_values[j]
+        if kind == "inst":
+            return inst_vals[j]
+        raise KeyError(key)
+
+    prev_end = 1
+    nch = cfg.num_perm_chunks
+    for ch in range(nch):
+        cols = list(enumerate(col_keys))[ch * PERM_CHUNK:(ch + 1) * PERM_CHUNK]
+        num = B.to_arr([1] * n)
+        den = B.to_arr([1] * n)
+        for gidx, key in cols:
+            v_arr = B.to_arr(col_values(key))
+            dj = pow(DELTA, gidx, R)
+            id_term = bk.add(v_arr, bk.add(bk.scale(omega_pows, beta * dj % R),
+                                           B.to_arr([gamma] * n)))
+            sig_term = bk.add(v_arr, bk.add(
+                bk.scale(B.to_arr(pk.sigma_values[gidx]), beta),
+                B.to_arr([gamma] * n)))
+            num = bk.mul(num, id_term)
+            den = bk.mul(den, sig_term)
+        ratio = bk.mul(num, bk.inv(den))
+        # deactivate blinding rows
+        ratio_ints = B.arr_to_ints(ratio)
+        for i in range(u, n):
+            ratio_ints[i] = 1
+        prefix = bk.prefix_prod(B.to_arr(ratio_ints))
+        prefix_ints = B.arr_to_ints(prefix)
+        z = [prev_end] + [prev_end * p % R for p in prefix_ints[:-1]]
+        prev_end = prev_end * prefix_ints[u - 1] % R if u >= 1 else prev_end
+        commit_col(("pz", ch), z)
+    assert prev_end == 1, "permutation product != 1 (copy constraints unsatisfiable)"
+
+    # --- 4. lookup grand products ---
+    for j in range(cfg.num_lookup_advice):
+        a_v, pa_v, pt_v = values[("ladv", j)], values[("pA", j)], values[("pT", j)]
+        t_v = pk.table_values
+        num = bk.mul(bk.add(B.to_arr(a_v), B.to_arr([beta] * n)),
+                     bk.add(B.to_arr(t_v), B.to_arr([gamma] * n)))
+        den = bk.mul(bk.add(B.to_arr(pa_v), B.to_arr([beta] * n)),
+                     bk.add(B.to_arr(pt_v), B.to_arr([gamma] * n)))
+        ratio = B.arr_to_ints(bk.mul(num, bk.inv(den)))
+        for i in range(u, n):
+            ratio[i] = 1
+        prefix = B.arr_to_ints(bk.prefix_prod(B.to_arr(ratio)))
+        z = [1] + prefix[:-1]
+        assert prefix[u - 1] == 1, "lookup product != 1"
+        commit_col(("lz", j), z)
+
+    y = tr.challenge()
+
+    # --- 5. quotient on the extended coset ---
+    ext_cache: dict = {}
+
+    def ext(key):
+        if key not in ext_cache:
+            if key in polys:
+                ext_cache[key] = dom.coeff_to_extended(polys[key], bk)
+            elif key[0] == "q":
+                ext_cache[key] = dom.coeff_to_extended(pk.selector_polys[key[1]], bk)
+            elif key[0] == "fix":
+                ext_cache[key] = dom.coeff_to_extended(pk.fixed_polys[key[1]], bk)
+            elif key[0] == "sig":
+                ext_cache[key] = dom.coeff_to_extended(pk.sigma_polys[key[1]], bk)
+            elif key[0] == "tab":
+                ext_cache[key] = dom.coeff_to_extended(pk.table_poly, bk)
+            elif key[0] == "inst":
+                coeffs = dom.lagrange_to_coeff(B.to_arr(inst_vals[key[1]]), bk)
+                polys[key] = coeffs
+                ext_cache[key] = dom.coeff_to_extended(coeffs, bk)
+            else:
+                raise KeyError(key)
+        return ext_cache[key]
+
+    class LazyCtx(_ArrayCtx):
+        def var(self, key, rot):
+            arr = ext(key)
+            if rot == 0:
+                return arr
+            if rot == ROT_LAST:
+                return dom.rotate_extended(arr, cfg.last_row)
+            return dom.rotate_extended(arr, rot)
+
+    ctx = LazyCtx(cfg, dom, bk, ext_cache)
+    # l0 / l_last / l_blind on the extended coset
+    l0_vals = [0] * n
+    l0_vals[0] = 1
+    llast_vals = [0] * n
+    llast_vals[cfg.last_row] = 1
+    lblind_vals = [0] * n
+    for i in range(u + 1, n):
+        lblind_vals[i] = 1
+    ctx.l0 = dom.coeff_to_extended(dom.lagrange_to_coeff(B.to_arr(l0_vals), bk), bk)
+    ctx.llast = dom.coeff_to_extended(dom.lagrange_to_coeff(B.to_arr(llast_vals), bk), bk)
+    ctx.lblind = dom.coeff_to_extended(dom.lagrange_to_coeff(B.to_arr(lblind_vals), bk), bk)
+
+    exprs = all_expressions(cfg, ctx, beta, gamma)
+    acc = None
+    for e in exprs:
+        acc = e if acc is None else bk.add(bk.scale(acc, y), e)
+    h_evals = bk.mul(acc, dom.vanishing_inv_on_extended())
+    h_coeffs = dom.extended_to_coeff(h_evals, bk)
+    # degree sanity: deg h <= 3n-4, so the top chunk must vanish — a nonzero
+    # tail means a constraint exceeded the degree-4 budget (silent truncation
+    # here would emit unverifiable proofs with no diagnostic)
+    assert not np.any(h_coeffs[3 * n:]), "quotient degree exceeds budget"
+    for i in range(3):
+        chunk = h_coeffs[i * n:(i + 1) * n]
+        if chunk.shape[0] < n:
+            chunk = np.vstack([chunk, np.zeros((n - chunk.shape[0], 4), np.uint64)])
+        polys[("h", i)] = chunk
+        tr.write_point(kzg.commit(srs, chunk, bk))
+
+    x = tr.challenge()
+
+    # --- 6. evaluations per the query plan ---
+    plan = pk.vk.query_plan()
+
+    def poly_for(key):
+        kind, j = key
+        if key in polys:
+            return polys[key]
+        if kind == "q":
+            return pk.selector_polys[j]
+        if kind == "fix":
+            return pk.fixed_polys[j]
+        if kind == "sig":
+            return pk.sigma_polys[j]
+        if kind == "tab":
+            return pk.table_poly
+        raise KeyError(key)
+
+    evals = {}
+    for key, rot in plan:
+        pt = pk.vk.rotation_point(x, rot)
+        ev = host.fp_horner(host.FR, poly_for(key), pt)
+        evals[(key, rot)] = ev
+        tr.write_scalar(ev)
+
+    # --- 7. SHPLONK multiopen ---
+    by_key: dict = {}
+    for key, rot in plan:
+        by_key.setdefault(key, []).append(rot)
+    entries = []
+    for key, rots in by_key.items():
+        pts = tuple(pk.vk.rotation_point(x, r) for r in rots)
+        evs = tuple(evals[(key, r)] for r in rots)
+        entries.append(kzg.OpenEntry(poly_for(key), None, pts, evs))
+    kzg.shplonk_open(srs, dom, entries, tr, bk)
+
+    return tr.finalize()

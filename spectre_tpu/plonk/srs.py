@@ -1,0 +1,85 @@
+"""KZG structured reference string (powers-of-tau), with file cache.
+
+Reference parity: halo2-base `gen_srs` / PARAMS_DIR caching
+(`util/circuit.rs` + SURVEY.md §5 checkpoint/resume). Production use consumes
+a ceremony transcript; tests generate an INSECURE deterministic setup from a
+seed (tau derived and then discarded — fine for testing, never for deployment).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+from ..fields import bn254
+from ..native import host
+
+R = bn254.R
+
+PARAMS_DIR = os.environ.get("PARAMS_DIR", os.path.join(os.path.dirname(__file__), "..", "..", "params"))
+
+
+class SRS:
+    """g1_powers: [n, 8] u64 affine standard limbs (tau^i G); g2 elements."""
+
+    def __init__(self, k: int, g1_powers: np.ndarray, g2_gen, g2_tau):
+        self.k = k
+        self.n = 1 << k
+        self.g1_powers = g1_powers
+        self.g2_gen = g2_gen
+        self.g2_tau = g2_tau
+
+    @classmethod
+    def unsafe_setup(cls, k: int, seed: bytes = b"spectre-tpu-test-srs") -> "SRS":
+        tau = int.from_bytes(hashlib.sha256(seed + bytes([k])).digest() * 2, "big") % R
+        n = 1 << k
+        g1p = host.g1_scalar_powers((int(bn.G1_GEN[0]), int(bn.G1_GEN[1])), tau, n) \
+            if (bn := bn254) else None
+        g2_tau = bn254.g2_curve.mul(bn254.G2_GEN, tau)
+        return cls(k, g1p, bn254.G2_GEN, g2_tau)
+
+    @classmethod
+    def load_or_setup(cls, k: int, directory: str | None = None) -> "SRS":
+        directory = directory or PARAMS_DIR
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"kzg_bn254_{k}.srs")
+        if os.path.exists(path):
+            return cls.read(path)
+        # derive from a larger cached SRS when available (prefix property)
+        for bigger in range(k + 1, 27):
+            bp = os.path.join(directory, f"kzg_bn254_{bigger}.srs")
+            if os.path.exists(bp):
+                big = cls.read(bp)
+                srs = cls(k, big.g1_powers[:1 << k].copy(), big.g2_gen, big.g2_tau)
+                srs.write(path)
+                return srs
+        srs = cls.unsafe_setup(k)
+        srs.write(path)
+        return srs
+
+    def truncate(self, k: int) -> "SRS":
+        assert k <= self.k
+        return SRS(k, self.g1_powers[:1 << k], self.g2_gen, self.g2_tau)
+
+    # -- serialization: header || g1 limbs || g2 points (uncompressed BE) --
+    def write(self, path: str):
+        with open(path, "wb") as f:
+            f.write(b"SPTSRS01")
+            f.write(self.k.to_bytes(4, "little"))
+            f.write(self.g1_powers.astype("<u8").tobytes())
+            f.write(bn254.g2_to_bytes(self.g2_gen))
+            f.write(bn254.g2_to_bytes(self.g2_tau))
+
+    @classmethod
+    def read(cls, path: str) -> "SRS":
+        with open(path, "rb") as f:
+            magic = f.read(8)
+            assert magic == b"SPTSRS01", "bad SRS file"
+            k = int.from_bytes(f.read(4), "little")
+            n = 1 << k
+            g1 = np.frombuffer(f.read(n * 8 * 8), dtype="<u8").reshape(n, 8).copy()
+            g2_gen = bn254.g2_from_bytes(f.read(128))
+            g2_tau = bn254.g2_from_bytes(f.read(128))
+        return cls(k, g1, g2_gen, g2_tau)

@@ -1,4 +1,8 @@
-"""Test configuration: force an 8-device virtual CPU mesh before JAX imports.
+"""Test configuration: force an 8-device virtual CPU mesh before JAX backend init.
+
+NOTE: this environment pins JAX_PLATFORMS=axon (TPU tunnel) via sitecustomize,
+and the env var cannot be overridden from here — jax.config.update CAN. The
+XLA_FLAGS host-device count must still be set before backend initialization.
 
 Multi-chip sharding (parallel/) is exercised on virtual CPU devices here; real
 TPU runs happen via bench.py / the driver's dryrun_multichip.
@@ -6,7 +10,13 @@ TPU runs happen via bench.py / the driver's dryrun_multichip.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# big circuit graphs compile slowly; persist compiled executables across runs
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cpu")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

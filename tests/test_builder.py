@@ -1,0 +1,145 @@
+"""Circuit builder (Context/GateChip/RangeChip) tests."""
+
+import pytest
+
+from spectre_tpu.builder import Context, GateChip, RangeChip
+from spectre_tpu.fields import bn254 as bn
+from spectre_tpu.plonk.keygen import keygen
+from spectre_tpu.plonk.mock import mock_prove
+from spectre_tpu.plonk.prover import prove
+from spectre_tpu.plonk.srs import SRS
+from spectre_tpu.plonk.verifier import verify
+
+R = bn.R
+
+
+def _mock(ctx, k=9, lookup_bits=8):
+    cfg = ctx.auto_config(k=k, lookup_bits=lookup_bits)
+    asg = ctx.assignment(cfg)
+    assert mock_prove(cfg, asg)
+    return cfg, asg
+
+
+class TestGateChip:
+    def test_arithmetic(self):
+        ctx, gate = Context(), GateChip()
+        a, b = ctx.load_witness(17), ctx.load_witness(5)
+        assert gate.add(ctx, a, b).value == 22
+        assert gate.sub(ctx, a, b).value == 12
+        assert gate.mul(ctx, a, b).value == 85
+        assert gate.mul_add(ctx, a, b, 100).value == 185
+        assert gate.neg(ctx, b).value == R - 5
+        assert gate.div_unsafe(ctx, a, b).value == 17 * pow(5, -1, R) % R
+        _mock(ctx)
+
+    def test_boolean_and_select(self):
+        ctx, gate = Context(), GateChip()
+        t, f = ctx.load_witness(1), ctx.load_witness(0)
+        gate.assert_bit(ctx, t)
+        gate.assert_bit(ctx, f)
+        assert gate.and_(ctx, t, f).value == 0
+        assert gate.or_(ctx, t, f).value == 1
+        assert gate.not_(ctx, f).value == 1
+        a, b = ctx.load_witness(111), ctx.load_witness(222)
+        assert gate.select(ctx, a, b, t).value == 111
+        assert gate.select(ctx, a, b, f).value == 222
+        assert gate.is_zero(ctx, f).value == 1
+        assert gate.is_zero(ctx, a).value == 0
+        assert gate.is_equal(ctx, a, a).value == 1
+        _mock(ctx)
+
+    def test_bits(self):
+        ctx, gate = Context(), GateChip()
+        a = ctx.load_witness(0b10110101)
+        bits = gate.num_to_bits(ctx, a, 8)
+        assert [b.value for b in bits] == [1, 0, 1, 0, 1, 1, 0, 1]
+        back = gate.bits_to_num(ctx, bits)
+        assert back.value == 0b10110101
+        _mock(ctx)
+
+    def test_inner_product(self):
+        ctx, gate = Context(), GateChip()
+        xs = [ctx.load_witness(v) for v in (2, 3, 5)]
+        ys = [ctx.load_witness(v) for v in (7, 11, 13)]
+        assert gate.inner_product(ctx, xs, ys).value == 2 * 7 + 3 * 11 + 5 * 13
+        assert gate.inner_product_const(ctx, xs, [1, 10, 100]).value == 532
+        _mock(ctx)
+
+    def test_copy_mismatch_caught(self):
+        ctx, gate = Context(), GateChip()
+        a, b = ctx.load_witness(1), ctx.load_witness(2)
+        with pytest.raises(AssertionError):
+            ctx.constrain_equal(a, b)
+
+
+class TestRangeChip:
+    def test_range_check(self):
+        ctx = Context()
+        rng = RangeChip(lookup_bits=8)
+        a = ctx.load_witness(0xABCDE)
+        rng.range_check(ctx, a, 20)
+        b = ctx.load_witness(255)
+        rng.range_check(ctx, b, 8)
+        z = ctx.load_witness(0)
+        rng.range_check(ctx, z, 1)
+        _mock(ctx)
+
+    def test_range_check_rejects_oversize_witness(self):
+        ctx = Context()
+        rng = RangeChip(lookup_bits=8)
+        a = ctx.load_witness(1 << 21)
+        with pytest.raises(AssertionError):
+            rng.range_check(ctx, a, 20)
+
+    def test_nonmultiple_width_is_tight(self):
+        # value fits 2^19 <= v < 2^20 boundary: 2^20 - 1 passes, 2^20 fails
+        ctx = Context()
+        rng = RangeChip(lookup_bits=8)
+        rng.range_check(ctx, ctx.load_witness((1 << 20) - 1), 20)
+        _mock(ctx)
+
+    def test_comparisons(self):
+        ctx = Context()
+        rng = RangeChip(lookup_bits=8)
+        a, b = ctx.load_witness(100), ctx.load_witness(200)
+        rng.check_less_than(ctx, a, b, 16)
+        assert rng.is_less_than(ctx, a, b, 16).value == 1
+        assert rng.is_less_than(ctx, b, a, 16).value == 0
+        assert rng.is_less_than(ctx, a, a, 16).value == 0
+        _mock(ctx)
+
+    def test_div_mod(self):
+        ctx = Context()
+        rng = RangeChip(lookup_bits=8)
+        a = ctx.load_witness(987654)
+        q, r = rng.div_mod(ctx, a, 1000, 20)
+        assert (q.value, r.value) == (987, 654)
+        _mock(ctx)
+
+
+class TestEndToEnd:
+    def test_builder_to_real_proof(self):
+        ctx, gate = Context(), GateChip()
+        rng = RangeChip(lookup_bits=8)
+        x = ctx.load_witness(77)
+        y = ctx.load_witness(1234)
+        z = gate.mul_add(ctx, x, y, 5)
+        rng.range_check(ctx, z, 20)
+        ctx.expose_public(z)
+        cfg, asg = _mock(ctx)
+        srs = SRS.unsafe_setup(9)
+        pk = keygen(srs, cfg, asg.fixed, asg.selectors, asg.copies)
+        proof = prove(pk, srs, asg)
+        assert verify(pk.vk, srs, [[z.value]], proof)
+        assert not verify(pk.vk, srs, [[z.value + 1]], proof)
+
+    def test_multi_column_layout(self):
+        # force enough cells that layout spills into multiple advice columns
+        ctx, gate = Context(), GateChip()
+        acc = ctx.load_witness(1)
+        for i in range(200):
+            acc = gate.mul_add(ctx, acc, 3, 1)
+        cfg = ctx.auto_config(k=8, lookup_bits=4)
+        assert cfg.num_advice >= 2
+        asg = ctx.assignment(cfg)
+        assert mock_prove(cfg, asg)

@@ -1,0 +1,261 @@
+"""Proving-system tests: KZG/SHPLONK, transcripts, full prove/verify."""
+
+import secrets
+
+import numpy as np
+import pytest
+
+from spectre_tpu.fields import bn254 as bn
+from spectre_tpu.native import host
+from spectre_tpu.plonk import backend as B, kzg
+from spectre_tpu.plonk.constraint_system import Assignment, CircuitConfig
+from spectre_tpu.plonk.domain import Domain
+from spectre_tpu.plonk.keygen import keygen
+from spectre_tpu.plonk.prover import prove
+from spectre_tpu.plonk.srs import SRS
+from spectre_tpu.plonk.transcript import Blake2bTranscript, KeccakTranscript, keccak256
+from spectre_tpu.plonk.verifier import verify
+
+K = 7
+
+
+@pytest.fixture(scope="module")
+def srs():
+    return SRS.unsafe_setup(K)
+
+
+class TestTranscript:
+    def test_keccak256_vectors(self):
+        # standard Keccak-256 (Ethereum) test vectors
+        assert keccak256(b"").hex() == \
+            "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+        assert keccak256(b"abc").hex() == \
+            "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+
+    def test_roundtrip_and_determinism(self):
+        for cls in (Blake2bTranscript, KeccakTranscript):
+            tw = cls()
+            pt = bn.g1_curve.mul(bn.G1_GEN, 7)
+            tw.write_point(pt)
+            tw.write_scalar(12345)
+            c1 = tw.challenge()
+            proof = tw.finalize()
+            tr = cls(proof)
+            assert tr.read_point() == pt
+            assert tr.read_scalar() == 12345
+            assert tr.challenge() == c1
+            tr.assert_consumed()
+
+    def test_infinity_point(self):
+        tw = Blake2bTranscript()
+        tw.write_point(None)
+        tr = Blake2bTranscript(tw.finalize())
+        assert tr.read_point() is None
+
+
+class TestDomain:
+    def test_lagrange_roundtrip(self):
+        dom = Domain(5)
+        vals = [secrets.randbelow(bn.R) for _ in range(32)]
+        arr = B.to_arr(vals)
+        back = dom.coeff_to_lagrange(dom.lagrange_to_coeff(arr))
+        assert B.arr_to_ints(back) == vals
+
+    def test_extended_roundtrip(self):
+        dom = Domain(4)
+        coeffs = B.to_arr([secrets.randbelow(bn.R) for _ in range(16)])
+        ext = dom.coeff_to_extended(coeffs)
+        back = dom.extended_to_coeff(ext)
+        assert B.arr_to_ints(back[:16]) == B.arr_to_ints(coeffs)
+        assert all(v == 0 for v in B.arr_to_ints(back[16:]))
+
+    def test_lagrange_evals(self):
+        dom = Domain(4)
+        x = secrets.randbelow(bn.R)
+        lag = dom.lagrange_evals(x, [0, 3])
+        # L_i(omega^i) = 1, L_i(omega^j) = 0
+        lag_at_dom = dom.lagrange_evals(dom.omega ** 3 % bn.R, [0, 3])
+        assert lag_at_dom[3] == 1 and lag_at_dom[0] == 0
+        # sum of all lagranges = 1
+        all_lag = dom.lagrange_evals(x, range(16))
+        assert sum(all_lag.values()) % bn.R == 1
+
+
+class TestSHPLONK:
+    def test_multipoint_roundtrip(self, srs):
+        dom = Domain(K)
+        n = 1 << K
+        c1 = B.to_arr([secrets.randbelow(bn.R) for _ in range(n)])
+        c2 = B.to_arr([secrets.randbelow(bn.R) for _ in range(n)])
+        C1, C2 = kzg.commit(srs, c1), kzg.commit(srs, c2)
+        x = secrets.randbelow(bn.R)
+        wx = x * dom.omega % bn.R
+        e1 = (host.fp_horner(host.FR, c1, x), host.fp_horner(host.FR, c1, wx))
+        e2 = (host.fp_horner(host.FR, c2, x),)
+        tw = Blake2bTranscript()
+        for e in e1 + e2:
+            tw.write_scalar(e)
+        kzg.shplonk_open(srs, dom, [
+            kzg.OpenEntry(c1, None, (x, wx), e1),
+            kzg.OpenEntry(c2, None, (x,), e2)], tw)
+        tr = Blake2bTranscript(tw.finalize())
+        f1 = (tr.read_scalar(), tr.read_scalar())
+        f2 = (tr.read_scalar(),)
+        assert kzg.shplonk_verify(srs, [
+            kzg.OpenEntry(None, C1, (x, wx), f1),
+            kzg.OpenEntry(None, C2, (x,), f2)], tr)
+
+    def test_bad_eval_rejected(self, srs):
+        dom = Domain(K)
+        n = 1 << K
+        c1 = B.to_arr([secrets.randbelow(bn.R) for _ in range(n)])
+        C1 = kzg.commit(srs, c1)
+        x = secrets.randbelow(bn.R)
+        bad = ((host.fp_horner(host.FR, c1, x) + 1) % bn.R,)
+        tw = Blake2bTranscript()
+        tw.write_scalar(bad[0])
+        kzg.shplonk_open(srs, dom, [kzg.OpenEntry(c1, None, (x,), bad)], tw)
+        tr = Blake2bTranscript(tw.finalize())
+        f = (tr.read_scalar(),)
+        assert not kzg.shplonk_verify(srs, [kzg.OpenEntry(None, C1, (x,), f)], tr)
+
+
+def _tiny_circuit(cfg):
+    """x + x*y = out, x range-checked, one constant pin."""
+    n = cfg.n
+    x_w, y_w = 7, 3
+    out = x_w + x_w * y_w
+    advice = [[0] * n for _ in range(cfg.num_advice)]
+    advice[0][0], advice[0][1], advice[0][2], advice[0][3] = x_w, x_w, y_w, out
+    advice[0][4] = 5
+    selectors = [[0] * n for _ in range(cfg.num_advice)]
+    selectors[0][0] = 1
+    lookup = [[0] * n for _ in range(cfg.num_lookup_advice)]
+    lookup[0][0] = x_w
+    fixed = [[0] * n for _ in range(cfg.num_fixed)]
+    fixed[0][0] = 5
+    copies = [
+        ((cfg.col_instance(0), 0), (cfg.col_gate_advice(0), 3)),
+        ((cfg.col_fixed(0), 0), (cfg.col_gate_advice(0), 4)),
+        ((cfg.col_gate_advice(0), 0), (cfg.col_lookup_advice(0), 0)),
+    ]
+    return advice, lookup, fixed, selectors, copies, out
+
+
+class TestProveVerify:
+    def test_end_to_end(self, srs):
+        cfg = CircuitConfig(k=K, num_advice=1, num_lookup_advice=1, num_fixed=1,
+                            lookup_bits=4)
+        advice, lookup, fixed, selectors, copies, out = _tiny_circuit(cfg)
+        pk = keygen(srs, cfg, fixed, selectors, copies)
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
+        proof = prove(pk, srs, asg)
+        assert verify(pk.vk, srs, [[out]], proof)
+        assert not verify(pk.vk, srs, [[out + 1]], proof)
+
+    def test_multi_advice_columns(self, srs):
+        # two gate columns + wider permutation (multiple chunks exercised)
+        cfg = CircuitConfig(k=K, num_advice=2, num_lookup_advice=1, num_fixed=1,
+                            lookup_bits=4)
+        n = cfg.n
+        advice = [[0] * n, [0] * n]
+        selectors = [[0] * n, [0] * n]
+        # col0: 2 + 3*4 = 14 ; col1: 14 + 14*1 = 28, cross-column copy
+        advice[0][0:4] = [2, 3, 4, 14]
+        selectors[0][0] = 1
+        advice[1][0:4] = [14, 14, 1, 28]
+        selectors[1][0] = 1
+        lookup = [[0] * n]
+        lookup[0][0] = 14
+        fixed = [[0] * n]
+        copies = [
+            ((cfg.col_gate_advice(0), 3), (cfg.col_gate_advice(1), 0)),
+            ((cfg.col_gate_advice(1), 0), (cfg.col_gate_advice(1), 1)),
+            ((cfg.col_gate_advice(0), 3), (cfg.col_lookup_advice(0), 0)),
+            ((cfg.col_instance(0), 0), (cfg.col_gate_advice(1), 3)),
+        ]
+        pk = keygen(srs, cfg, fixed, selectors, copies)
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, [[28]], copies)
+        proof = prove(pk, srs, asg)
+        assert verify(pk.vk, srs, [[28]], proof)
+
+    def test_invalid_gate_witness_rejected(self, srs):
+        cfg = CircuitConfig(k=K, num_advice=1, num_lookup_advice=1, num_fixed=1,
+                            lookup_bits=4)
+        advice, lookup, fixed, selectors, copies, out = _tiny_circuit(cfg)
+        advice[0][2] = 999  # breaks the gate (x + x*y != out)
+        pk = keygen(srs, cfg, fixed, selectors, copies)
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
+        proof = prove(pk, srs, asg)
+        assert not verify(pk.vk, srs, [[out]], proof)
+
+    def test_out_of_range_lookup_rejected_at_prove(self, srs):
+        cfg = CircuitConfig(k=K, num_advice=1, num_lookup_advice=1, num_fixed=1,
+                            lookup_bits=4)
+        advice, lookup, fixed, selectors, copies, out = _tiny_circuit(cfg)
+        lookup[0][1] = 99999  # not in [0, 16)
+        pk = keygen(srs, cfg, fixed, selectors, copies)
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
+        with pytest.raises(AssertionError, match="not in table"):
+            prove(pk, srs, asg)
+
+    def test_copy_violation_rejected_at_prove(self, srs):
+        cfg = CircuitConfig(k=K, num_advice=1, num_lookup_advice=1, num_fixed=1,
+                            lookup_bits=4)
+        advice, lookup, fixed, selectors, copies, out = _tiny_circuit(cfg)
+        advice[0][4] = 6  # violates the constant-5 copy constraint
+        pk = keygen(srs, cfg, fixed, selectors, copies)
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
+        with pytest.raises(AssertionError, match="permutation product"):
+            prove(pk, srs, asg)
+
+    def test_proof_is_zk_randomized(self, srs):
+        cfg = CircuitConfig(k=K, num_advice=1, num_lookup_advice=1, num_fixed=1,
+                            lookup_bits=4)
+        advice, lookup, fixed, selectors, copies, out = _tiny_circuit(cfg)
+        pk = keygen(srs, cfg, fixed, selectors, copies)
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
+        p1 = prove(pk, srs, asg)
+        p2 = prove(pk, srs, asg)
+        assert p1 != p2  # blinding rows differ
+        assert verify(pk.vk, srs, [[out]], p1) and verify(pk.vk, srs, [[out]], p2)
+
+
+class TestMockProver:
+    def test_satisfied(self):
+        from spectre_tpu.plonk.mock import mock_prove
+        cfg = CircuitConfig(k=7, num_advice=1, num_lookup_advice=1, num_fixed=1,
+                            lookup_bits=4)
+        advice, lookup, fixed, selectors, copies, out = _tiny_circuit(cfg)
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
+        assert mock_prove(cfg, asg)
+
+    def test_reports_gate_violation(self):
+        from spectre_tpu.plonk.mock import mock_prove
+        cfg = CircuitConfig(k=7, num_advice=1, num_lookup_advice=1, num_fixed=1,
+                            lookup_bits=4)
+        advice, lookup, fixed, selectors, copies, out = _tiny_circuit(cfg)
+        advice[0][2] = 12  # gate broken
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
+        with pytest.raises(AssertionError, match="constraint #0 violated at row 0"):
+            mock_prove(cfg, asg)
+
+    def test_reports_copy_violation(self):
+        from spectre_tpu.plonk.mock import mock_prove
+        cfg = CircuitConfig(k=7, num_advice=1, num_lookup_advice=1, num_fixed=1,
+                            lookup_bits=4)
+        advice, lookup, fixed, selectors, copies, out = _tiny_circuit(cfg)
+        advice[0][4] = 99
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
+        with pytest.raises(AssertionError, match="copy constraint violated"):
+            mock_prove(cfg, asg)
+
+    def test_reports_lookup_violation(self):
+        from spectre_tpu.plonk.mock import mock_prove
+        cfg = CircuitConfig(k=7, num_advice=1, num_lookup_advice=1, num_fixed=1,
+                            lookup_bits=4)
+        advice, lookup, fixed, selectors, copies, out = _tiny_circuit(cfg)
+        lookup[0][9] = 1 << 20
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
+        with pytest.raises(AssertionError, match="not in table"):
+            mock_prove(cfg, asg)
