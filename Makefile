@@ -1,0 +1,31 @@
+# Build orchestration (reference parity: `justfile` recipes).
+
+.PHONY: all native test test-slow fixtures bench setup-committee setup-step lint
+
+all: native
+
+native:
+	$(MAKE) -C spectre_tpu/native
+
+test: native
+	python -m pytest tests/ -q
+
+test-slow: native
+	RUN_SLOW=1 python -m pytest tests/ -q
+
+fixtures:
+	python -c "from spectre_tpu.test_utils import generate_fixtures; \
+	from spectre_tpu import spec; generate_fixtures(spec.TINY); \
+	generate_fixtures(spec.MINIMAL)"
+
+setup-committee:
+	python -m spectre_tpu.prover_service.cli --spec tiny circuit committee-update setup --k 17
+
+setup-step:
+	python -m spectre_tpu.prover_service.cli --spec tiny circuit sync-step setup --k 17
+
+bench: native
+	python bench.py
+
+lint:
+	python -m compileall -q spectre_tpu tests bench.py __graft_entry__.py

@@ -25,7 +25,7 @@ class AssignedValue:
     """Handle to a stream cell: (stream id, index). value is a cached int."""
 
     ctx: "Context"
-    stream: str      # "adv" | "lkp"
+    stream: str      # always "adv" (lookup streams hold raw copies, no handles)
     index: int
 
     @property
@@ -40,15 +40,17 @@ class Context:
     def __init__(self):
         self.adv_values: list[int] = []       # advice stream
         self.adv_units: list[tuple[int, int, bool]] = []  # (start, size, gated)
-        self.lkp_values: list[int] = []       # lookup stream (range-checked)
+        # lookup streams, one per table id ("range", "nibble_op", ...)
+        self.lkp_streams: dict[str, list[int]] = {}
         self.copies: list[tuple] = []         # ((stream, idx), (stream, idx))
         self.constants: dict[int, int] = {}   # value -> fixed row
         self.const_uses: list[tuple[int, int]] = []  # (adv idx, fixed row)
         self.instance_cells: list[AssignedValue] = []
 
     # -- stream access --
-    def stream_values(self, stream: str) -> list[int]:
-        return self.adv_values if stream == "adv" else self.lkp_values
+    def stream_values(self, stream) -> list[int]:
+        assert stream == "adv", "handles only exist for the advice stream"
+        return self.adv_values
 
     # -- primitive appends --
     def _push_unit(self, vals: list[int], gated: bool) -> int:
@@ -81,8 +83,7 @@ class Context:
             av = AssignedValue(self, "adv", start + i)
             if isinstance(src, AssignedValue):
                 assert src.value == vals[i] % R, "copy value mismatch"
-                self.copies.append((("adv", src.index) if src.stream == "adv"
-                                    else ("lkp", src.index), ("adv", start + i)))
+                self.copies.append(((src.stream, src.index), ("adv", start + i)))
             elif isinstance(src, tuple) and src and src[0] == "const":
                 row = self.constants.setdefault(src[1] % R, len(self.constants))
                 self.const_uses.append((start + i, row))
@@ -90,10 +91,16 @@ class Context:
         return out
 
     def push_lookup(self, av: AssignedValue) -> None:
-        """Copy a cell into the lookup (range-table) stream."""
-        idx = len(self.lkp_values)
-        self.lkp_values.append(av.value)
-        self.copies.append((("adv", av.index), ("lkp", idx)))
+        """Copy a cell into the range-table lookup stream."""
+        self.push_lookup_table(av, "range")
+
+    def push_lookup_table(self, av: AssignedValue, table: str) -> None:
+        """Copy a cell into the lookup stream of the given table."""
+        assert av.stream == "adv"
+        stream = self.lkp_streams.setdefault(table, [])
+        idx = len(stream)
+        stream.append(av.value)
+        self.copies.append((("adv", av.index), (("lkp", table), idx)))
 
     def constrain_equal(self, a: AssignedValue, b: AssignedValue):
         assert a.value == b.value, "constrain_equal on unequal values"
@@ -116,7 +123,7 @@ class Context:
     def stats(self) -> dict:
         return {
             "advice_cells": len(self.adv_values),
-            "lookup_cells": len(self.lkp_values),
+            "lookup_cells": {t: len(v) for t, v in self.lkp_streams.items()},
             "copies": len(self.copies),
             "constants": len(self.constants),
             "instances": len(self.instance_cells),
@@ -131,11 +138,16 @@ class Context:
         # advice columns: account for per-unit padding at column breaks (worst
         # case wastes <= 3 rows per column)
         num_advice = max(min_advice, (len(self.adv_values) + u - 1) // (u - 3))
-        num_lookup = max(1, (len(self.lkp_values) + u - 1) // u)
+        tables = []
+        for tid in sorted(self.lkp_streams):
+            ncols = max(1, (len(self.lkp_streams[tid]) + u - 1) // u)
+            tables.extend([tid] * ncols)
+        if not tables:
+            tables = ["range"]  # config always carries at least one table
         num_fixed = max(1, (len(self.constants) + u - 1) // u)
         return CircuitConfig(k=k, num_advice=num_advice,
-                             num_lookup_advice=num_lookup, num_fixed=num_fixed,
-                             lookup_bits=lookup_bits)
+                             num_lookup_advice=len(tables), num_fixed=num_fixed,
+                             lookup_bits=lookup_bits, lookup_tables=tuple(tables))
 
     def layout(self, cfg: CircuitConfig):
         """Place units into columns. Returns (advice_cols, lookup_cols,
@@ -163,11 +175,19 @@ class Context:
 
         lookup = [[0] * n for _ in range(cfg.num_lookup_advice)]
         lkp_placement = {}
-        for idx, v in enumerate(self.lkp_values):
-            c, r = divmod(idx, u)
-            assert c < cfg.num_lookup_advice, "lookup overflow"
-            lookup[c][r] = v
-            lkp_placement[idx] = (c, r)
+        # columns grouped by table id (order must match cfg.lookup_tables)
+        cols_for_table: dict[str, list[int]] = {}
+        for j in range(cfg.num_lookup_advice):
+            cols_for_table.setdefault(cfg.table_id(j), []).append(j)
+        for tid, stream in self.lkp_streams.items():
+            cols = cols_for_table.get(tid, [])
+            assert cols, f"no lookup column configured for table {tid}"
+            for idx, v in enumerate(stream):
+                ci, r = divmod(idx, u)
+                assert ci < len(cols), f"lookup overflow for table {tid}"
+                c = cols[ci]
+                lookup[c][r] = v
+                lkp_placement[(tid, idx)] = (c, r)
 
         fixed = [[0] * n for _ in range(cfg.num_fixed)]
         fix_placement = {}
@@ -182,7 +202,7 @@ class Context:
             if stream == "adv":
                 c, r = placement[idx]
                 return (cfg.col_gate_advice(c), r)
-            c, r = lkp_placement[idx]
+            c, r = lkp_placement[(stream[1], idx)]
             return (cfg.col_lookup_advice(c), r)
 
         copies = [(cell_coord(*a), cell_coord(*b)) for a, b in self.copies]

@@ -1,0 +1,11 @@
+"""On-chain layer: the Spectre light-client state machine + verifier interface.
+
+Reference parity (SURVEY.md L6): the `Spectre.sol` contract (head tracking,
+per-period committee poseidons, block/execution root maps) and
+`contract-tests/` (protocol tests against MockVerifiers). The EVM toolchain
+(solc/anvil) is not available in this environment, so the contract logic is
+maintained as an executable Python reference model with the same storage
+layout and entry points; Solidity emission tracks it in round 2+.
+"""
+
+from .spectre import MockVerifier, NativeVerifier, SpectreContract  # noqa: F401

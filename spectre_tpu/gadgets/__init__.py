@@ -1,0 +1,9 @@
+"""Reusable circuit gadgets above the chips.
+
+Reference parity (SURVEY.md L2): `ssz_merkle.rs` (merkleization + branch
+verification), `poseidon.rs` (committee commitment), `gadget/common.rs` /
+`util/bytes.rs` (byte/limb plumbing).
+"""
+
+from .ssz_merkle import merkleize_chunks, verify_merkle_proof  # noqa: F401
+from .poseidon_commit import g1_array_poseidon  # noqa: F401

@@ -1,0 +1,11 @@
+"""Application circuits — the "model families" of this framework.
+
+Reference parity (SURVEY.md L3): `sync_step_circuit.rs` (StepCircuit),
+`committee_update_circuit.rs` (CommitteeUpdateCircuit),
+`aggregation_circuit.rs` (proof compression). Circuits are written against
+the builder chips and proved by the plonk backend (cpu or tpu).
+"""
+
+from .app_circuit import AppCircuit  # noqa: F401
+from .committee_update import CommitteeUpdateCircuit  # noqa: F401
+from .step import StepCircuit  # noqa: F401

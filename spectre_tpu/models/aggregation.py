@@ -1,0 +1,84 @@
+"""Proof aggregation / compression layer.
+
+Reference parity: `aggregation_circuit.rs` (snark-verifier's
+`AggregationCircuit`: one-layer SHPLONK compression of an app snark, keeping
+the 12 KZG accumulator limbs + the app instances as public inputs).
+
+ROUND-1 SCOPE: recursive in-circuit verification of a BN254 KZG proof needs
+the non-native Fq ECC chip (the same machinery as the in-circuit BLS pairing)
+— that is the round-2 milestone. This module already provides:
+  * the aggregation STATEMENT layout (accumulator limbs || app instances),
+    matching `expose_previous_instances(false)`;
+  * KZG accumulation of the deferred pairing checks of N app proofs into ONE
+    pairing (the heart of the aggregation argument, runs natively today and
+    becomes the in-circuit constraint in round 2);
+  * batch verification API used by the RPC/CLI layer.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+
+from ..fields import bn254
+from ..plonk.srs import SRS
+from ..plonk.verifier import verify as plonk_verify
+
+R = bn254.R
+ACC_LIMB_BITS = 88
+ACC_LIMBS_PER_COORD = 3  # 12 limbs total: (lhs.x, lhs.y, rhs.x, rhs.y) x 3
+
+
+@dataclass
+class Accumulator:
+    """Deferred KZG pairing check: e(lhs, [tau]_2) == e(rhs, [1]_2)."""
+
+    lhs: object  # G1 point
+    rhs: object
+
+    def limbs(self) -> list[int]:
+        """12 x 88-bit limbs, the aggregation circuit's first instances
+        (reference: accumulator limb encoding in snark-verifier)."""
+        out = []
+        for pt in (self.lhs, self.rhs):
+            for coord in (int(pt[0]), int(pt[1])):
+                for i in range(ACC_LIMBS_PER_COORD):
+                    out.append((coord >> (ACC_LIMB_BITS * i))
+                               & ((1 << ACC_LIMB_BITS) - 1))
+        return out
+
+    def check(self, srs: SRS) -> bool:
+        g1 = bn254.g1_curve
+        return bn254.pairing_check([
+            (self.lhs, srs.g2_tau),
+            (g1.neg(self.rhs), srs.g2_gen),
+        ])
+
+
+def accumulate(accs: list[Accumulator]) -> Accumulator:
+    """Random-linear-combination of deferred pairing checks into one."""
+    g1 = bn254.g1_curve
+    lhs, rhs = None, None
+    for acc in accs:
+        r = secrets.randbelow(R)
+        lhs = g1.add(lhs, g1.mul(acc.lhs, r))
+        rhs = g1.add(rhs, g1.mul(acc.rhs, r))
+    return Accumulator(lhs, rhs)
+
+
+class AggregationCircuit:
+    """Round-1 API shell: batch-verifies app proofs and produces the
+    aggregation statement (accumulator limbs || flattened app instances)."""
+
+    name = "aggregation"
+
+    @classmethod
+    def aggregate_statement(cls, acc: Accumulator, app_instances: list) -> list:
+        return acc.limbs() + [v % R for v in app_instances]
+
+    @classmethod
+    def batch_verify(cls, vk, srs: SRS, items: list) -> bool:
+        """items: [(instances, proof)] — verifies each app proof (native;
+        becomes one recursive proof in round 2)."""
+        return all(plonk_verify(vk, srs, [inst], proof)
+                   for inst, proof in items)

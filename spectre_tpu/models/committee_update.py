@@ -1,0 +1,128 @@
+"""CommitteeUpdateCircuit: map the next sync committee to its commitments.
+
+Reference parity: `committee_update_circuit.rs` — in-circuit logic
+(`assign_virtual:50`): SSZ root of the compressed pubkey list, X-coordinate
+decode (`decode_pubkeys_x:129`), Poseidon commitment, finalized-header SSZ
+root, committee-branch merkle proof against the finalized STATE root; public
+outputs [poseidon_commit, header_root_lo, header_root_hi]
+(`get_instances:198`).
+"""
+
+from __future__ import annotations
+
+from ..builder import Context, GateChip
+from ..builder.poseidon_chip import PoseidonChip
+from ..builder.sha256_chip import Sha256Chip
+from ..fields import bn254
+from ..gadgets import poseidon_commit as PC
+from ..gadgets import ssz_merkle as M
+from ..spec import LIMB_BITS, NUM_LIMBS
+from ..witness.types import CommitteeUpdateArgs
+from .app_circuit import AppCircuit
+
+R = bn254.R
+
+
+class CommitteeUpdateCircuit(AppCircuit):
+    name = "committee_update"
+
+    @classmethod
+    def build(cls, ctx: Context, args: CommitteeUpdateArgs, spec):
+        gate = GateChip()
+        sha = Sha256Chip(gate)
+        poseidon = PoseidonChip(gate)
+        n = spec.sync_committee_size
+        assert len(args.pubkeys_compressed) == n
+
+        # load pubkey bytes (8-bit checked once; reused by SSZ + decode)
+        pubkey_bytes = []
+        for pk in args.pubkeys_compressed:
+            assert len(pk) == 48
+            cells = []
+            for bt in pk:
+                c = ctx.load_witness(bt)
+                sha._range_bits(ctx, c, 8)
+                cells.append(c)
+            pubkey_bytes.append(cells)
+
+        # --- committee pubkeys SSZ root (leaf = sha256(pk padded to 64)) ---
+        zero = ctx.load_constant(0)
+        leaves = []
+        for cells in pubkey_bytes:
+            padded = cells + [zero] * 16
+            leaves.append(sha.digest_bytes(ctx, padded))
+        committee_root = M.merkleize_chunks(ctx, sha, leaves)
+
+        # --- decode X coordinates + y signs; Poseidon commitment ---
+        limbs_list, sign_cells = [], []
+        for cells in pubkey_bytes:
+            flag_byte = cells[0]  # big-endian first byte carries the 3 flags
+            bits = gate.num_to_bits(ctx, flag_byte, 8)
+            cleared = gate.bits_to_num(ctx, bits[:5])
+            y_sign = bits[5]
+            le_bytes = list(reversed(cells[1:])) + [cleared]  # little-endian X
+            limbs = []
+            for i in range(NUM_LIMBS):
+                chunk = le_bytes[13 * i:13 * i + 13]
+                if chunk:
+                    limbs.append(gate.inner_product_const(
+                        ctx, chunk, [1 << (8 * j) for j in range(len(chunk))]))
+                else:
+                    limbs.append(ctx.load_constant(0))
+            limbs_list.append(limbs)
+            sign_cells.append(y_sign)
+        poseidon_commit = PC.g1_array_poseidon(ctx, gate, poseidon,
+                                               limbs_list, sign_cells)
+
+        # --- finalized header SSZ root ---
+        def uint64_chunk_cells(v: int):
+            cells = []
+            for i in range(8):
+                c = ctx.load_witness((int(v) >> (8 * i)) & 0xFF)
+                sha._range_bits(ctx, c, 8)
+                cells.append(c)
+            return cells + [zero] * 24
+
+        def root_chunk_cells(b: bytes):
+            cells = []
+            for bt in b:
+                c = ctx.load_witness(bt)
+                sha._range_bits(ctx, c, 8)
+                cells.append(c)
+            return cells
+
+        hdr = args.finalized_header
+        state_root_cells = root_chunk_cells(hdr.state_root)
+        header_chunks = [
+            M.bytes_to_chunk(ctx, sha, uint64_chunk_cells(hdr.slot)),
+            M.bytes_to_chunk(ctx, sha, uint64_chunk_cells(hdr.proposer_index)),
+            M.bytes_to_chunk(ctx, sha, root_chunk_cells(hdr.parent_root)),
+            M.bytes_to_chunk(ctx, sha, state_root_cells),
+            M.bytes_to_chunk(ctx, sha, root_chunk_cells(hdr.body_root)),
+        ]
+        header_root = M.merkleize_chunks(ctx, sha, header_chunks, limit=8)
+
+        # --- committee branch against the finalized state root ---
+        branch = [M.bytes_to_chunk(ctx, sha, root_chunk_cells(b))
+                  for b in args.sync_committee_branch]
+        state_chunk = M.bytes_to_chunk(ctx, sha, state_root_cells)
+        M.verify_merkle_proof(ctx, sha, committee_root, branch,
+                              spec.sync_committee_pubkeys_root_index, state_chunk)
+
+        # --- public inputs: [poseidon, header_root_lo, header_root_hi] ---
+        hi, lo = M.chunk_to_le_hilo(ctx, gate, header_root)
+        ctx.expose_public(poseidon_commit)
+        ctx.expose_public(lo)
+        ctx.expose_public(hi)
+        return [poseidon_commit, lo, hi]
+
+    @classmethod
+    def get_instances(cls, args: CommitteeUpdateArgs, spec) -> list:
+        """Native recomputation (reference `get_instances:198`)."""
+        from ..fields import bls12_381 as bls
+        pts = [bls.g1_decompress(pk) for pk in args.pubkeys_compressed]
+        poseidon = PC.committee_poseidon_from_uncompressed(pts)
+        root = args.finalized_header.hash_tree_root()
+        lo = int.from_bytes(root[16:], "big")
+        hi = int.from_bytes(root[:16], "big")
+        return [poseidon, lo, hi]

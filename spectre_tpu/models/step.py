@@ -1,0 +1,223 @@
+"""StepCircuit: verify one sync-step of the Altair light-client protocol.
+
+Reference parity: `sync_step_circuit.rs` (`assign_virtual:64`): participation
+bit-check + sum, Poseidon commitment of the committee (with in-circuit y-sign
+derivation via big-less-than, `:317-331`), SSZ roots of the attested and
+finalized headers, the signing root, two merkle proofs (finality `:174-183`,
+execution `:186-195`), and the SHA256 public-input commitment truncated to
+253 bits (`:199-221`, `truncate_sha256_into_single_elem:368`). Instances:
+[pub_inputs_commit, poseidon_commit] (`get_instances:228`).
+
+ROUND-1 SCOPE NOTE: the BLS12-381 aggregate-signature pairing check
+(`assert_valid_signature`, hash-to-curve and the 512-iteration conditional
+point-add loop, `aggregate_pubkeys:292`) is verified NATIVELY during witness
+preparation (preprocessor rejects invalid signatures) but is NOT YET
+constrained in-circuit — the non-native Fq pairing chip is the round-2
+milestone. Everything else matches the reference constraint set.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..builder import Context, GateChip, RangeChip
+from ..builder.poseidon_chip import PoseidonChip
+from ..builder.sha256_chip import Sha256Chip
+from ..fields import bls12_381 as bls
+from ..gadgets import poseidon_commit as PC
+from ..gadgets import ssz_merkle as M
+from ..spec import LIMB_BITS, NUM_LIMBS
+from ..witness.types import SyncStepArgs
+from .app_circuit import AppCircuit
+
+LIMB_MASK = (1 << LIMB_BITS) - 1
+HALF_P = (bls.P - 1) // 2
+
+
+def _fq_limbs(v: int):
+    return [(int(v) >> (LIMB_BITS * i)) & LIMB_MASK for i in range(NUM_LIMBS)]
+
+
+class StepCircuit(AppCircuit):
+    name = "sync_step"
+
+    @classmethod
+    def build(cls, ctx: Context, args: SyncStepArgs, spec):
+        gate = GateChip()
+        rng = RangeChip(cls.default_lookup_bits, gate)
+        sha = Sha256Chip(gate)
+        poseidon = PoseidonChip(gate)
+        n = spec.sync_committee_size
+        assert len(args.pubkeys_uncompressed) == n
+        assert len(args.participation_bits) == n
+
+        # --- witness-side signature sanity (in-circuit pairing: round 2) ---
+        participating = [pk for pk, b in
+                         zip(args.pubkeys_uncompressed, args.participation_bits) if b]
+        sig = bls.g2_decompress(args.signature_compressed)
+        pts = [(bls.Fq(x), bls.Fq(y)) for x, y in participating]
+        assert bls.fast_aggregate_verify(pts, args.signing_root(), sig,
+                                         dst=spec.dst), \
+            "aggregate signature invalid (native check)"
+
+        # --- participation bits + sum ---
+        bit_cells = []
+        for b in args.participation_bits:
+            c = ctx.load_witness(int(b))
+            gate.assert_bit(ctx, c)
+            bit_cells.append(c)
+        participation_sum = gate.sum_(ctx, bit_cells)
+
+        # --- committee poseidon commitment (x limbs + derived y signs) ---
+        half_p_limbs = _fq_limbs(HALF_P)
+        limbs_list, sign_cells = [], []
+        for x, y in args.pubkeys_uncompressed:
+            x_limbs = [ctx.load_witness(l) for l in _fq_limbs(x)]
+            y_limbs = [ctx.load_witness(l) for l in _fq_limbs(y)]
+            for l in x_limbs + y_limbs:
+                rng.range_check(ctx, l, LIMB_BITS)
+            # y_sign = ((p-1)/2 < y): limb-wise lexicographic comparison
+            sign = cls._big_less_than_const(ctx, gate, rng, half_p_limbs, y_limbs)
+            limbs_list.append(x_limbs)
+            sign_cells.append(sign)
+        poseidon_commit = PC.g1_array_poseidon(ctx, gate, poseidon,
+                                               limbs_list, sign_cells)
+
+        # --- header roots + signing root ---
+        zero = ctx.load_constant(0)
+
+        def byte_cells_checked(bs: bytes):
+            out = []
+            for bt in bs:
+                c = ctx.load_witness(bt)
+                sha._range_bits(ctx, c, 8)
+                out.append(c)
+            return out
+
+        def uint64_cells(v: int):
+            out = byte_cells_checked(int(v).to_bytes(8, "little"))
+            return out
+
+        def header_chunks(hdr):
+            slot_cells = uint64_cells(hdr.slot)
+            chunks = [
+                M.bytes_to_chunk(ctx, sha, slot_cells + [zero] * 24),
+                M.bytes_to_chunk(ctx, sha, uint64_cells(hdr.proposer_index) + [zero] * 24),
+                M.bytes_to_chunk(ctx, sha, byte_cells_checked(hdr.parent_root)),
+                M.bytes_to_chunk(ctx, sha, byte_cells_checked(hdr.state_root)),
+                M.bytes_to_chunk(ctx, sha, byte_cells_checked(hdr.body_root)),
+            ]
+            return slot_cells, chunks
+
+        att_slot_cells, att_chunks = header_chunks(args.attested_header)
+        fin_slot_cells, fin_chunks = header_chunks(args.finalized_header)
+        attested_root = M.merkleize_chunks(ctx, sha, att_chunks, limit=8)
+        finalized_root = M.merkleize_chunks(ctx, sha, fin_chunks, limit=8)
+
+        domain_chunk = M.bytes_to_chunk(ctx, sha, byte_cells_checked(args.domain))
+        _signing_root = sha.digest_two_to_one(ctx, attested_root, domain_chunk)
+        # (signing_root binds the BLS message; consumed by the round-2
+        #  in-circuit hash-to-curve)
+
+        # --- merkle proofs ---
+        att_state_chunk = att_chunks[3]
+        fin_branch = [M.bytes_to_chunk(ctx, sha, byte_cells_checked(b))
+                      for b in args.finality_branch]
+        M.verify_merkle_proof(ctx, sha, finalized_root, fin_branch,
+                              spec.finalized_header_index, att_state_chunk)
+
+        exec_chunk = M.bytes_to_chunk(ctx, sha,
+                                      byte_cells_checked(args.execution_payload_root))
+        exec_branch = [M.bytes_to_chunk(ctx, sha, byte_cells_checked(b))
+                       for b in args.execution_payload_branch]
+        fin_body_chunk = fin_chunks[4]
+        M.verify_merkle_proof(ctx, sha, exec_chunk, exec_branch,
+                              spec.execution_state_root_index, fin_body_chunk)
+
+        # --- public input commitment ---
+        sum_cells = []
+        sv = participation_sum.value
+        for i in range(8):
+            c = ctx.load_witness((sv >> (8 * i)) & 0xFF)
+            sha._range_bits(ctx, c, 8)
+            sum_cells.append(c)
+        acc = gate.inner_product_const(ctx, sum_cells, [1 << (8 * i) for i in range(8)])
+        ctx.constrain_equal(acc, participation_sum)
+
+        fin_root_bytes = cls._chunk_bytes(ctx, gate, sha, finalized_root)
+        exec_root_bytes = cls._chunk_bytes(ctx, gate, sha, exec_chunk)
+
+        concat = (att_slot_cells + fin_slot_cells + sum_cells
+                  + fin_root_bytes + exec_root_bytes)
+        digest_words = sha.digest_bytes(ctx, concat)
+        pub_commit = cls._truncate_words_le(ctx, gate, sha, digest_words)
+
+        ctx.expose_public(pub_commit)
+        ctx.expose_public(poseidon_commit)
+        return [pub_commit, poseidon_commit]
+
+    # -- helpers ---------------------------------------------------------
+    @staticmethod
+    def _big_less_than_const(ctx, gate: GateChip, rng: RangeChip,
+                             a_limbs_const: list, b_limbs: list):
+        """(a < b) for a constant limb vector vs limb cells (both LIMB_BITS)."""
+        result = None
+        eq_chain = None
+        for i in range(NUM_LIMBS - 1, -1, -1):
+            ac = ctx.load_constant(a_limbs_const[i])
+            lt = rng.is_less_than(ctx, ac, b_limbs[i], LIMB_BITS)
+            eq = gate.is_equal(ctx, ac, b_limbs[i])
+            if result is None:
+                result = lt
+                eq_chain = eq
+            else:
+                term = gate.and_(ctx, eq_chain, lt)
+                result = gate.or_(ctx, result, term)
+                eq_chain = gate.and_(ctx, eq_chain, eq)
+        return result
+
+    @staticmethod
+    def _chunk_bytes(ctx, gate: GateChip, sha: Sha256Chip, chunk: list):
+        """8-Word chunk -> 32 byte cells (BE), byte-decomposed + constrained."""
+        out = []
+        for w in chunk:
+            v = w.value
+            cells = []
+            for i in range(4):
+                c = ctx.load_witness((v >> (8 * (3 - i))) & 0xFF)
+                sha._range_bits(ctx, c, 8)
+                cells.append(c)
+            acc = gate.inner_product_const(ctx, cells, [1 << 24, 1 << 16, 1 << 8, 1])
+            ctx.constrain_equal(acc, w.cell)
+            out.extend(cells)
+        return out
+
+    @staticmethod
+    def _truncate_words_le(ctx, gate: GateChip, sha: Sha256Chip, words: list):
+        """SHA digest (8 BE Words) -> field element from LE bytes with the top
+        3 bits dropped (reference `truncate_sha256_into_single_elem:368`)."""
+        byte_cells = StepCircuit._chunk_bytes(ctx, gate, sha, words)
+        # byte 31 (last LE byte... byte_cells are BE order: byte 31 is index 31)
+        top = byte_cells[31]
+        bits = gate.num_to_bits(ctx, top, 8)
+        cleared = gate.bits_to_num(ctx, bits[:5])
+        # LE interpretation: digest[i] has weight 2^(8i), digest[31] masked
+        coeffs = [1 << (8 * i) for i in range(32)]
+        ordered = byte_cells[:31] + [cleared]
+        return gate.inner_product_const(ctx, ordered, coeffs)
+
+    @classmethod
+    def get_instances(cls, args: SyncStepArgs, spec) -> list:
+        """Native recomputation (reference `get_instances:228`)."""
+        participation = sum(args.participation_bits)
+        data = (int(args.attested_header.slot).to_bytes(8, "little")
+                + int(args.finalized_header.slot).to_bytes(8, "little")
+                + int(participation).to_bytes(8, "little")
+                + args.finalized_header.hash_tree_root()
+                + args.execution_payload_root)
+        digest = bytearray(hashlib.sha256(data).digest())
+        digest[31] &= 0x1F
+        pub_commit = int.from_bytes(bytes(digest), "little")
+        pts = [(bls.Fq(x), bls.Fq(y)) for x, y in args.pubkeys_uncompressed]
+        poseidon = PC.committee_poseidon_from_uncompressed(pts)
+        return [pub_commit, poseidon]

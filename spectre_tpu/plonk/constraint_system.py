@@ -32,7 +32,12 @@ PERM_CHUNK = 2  # columns per permutation grand-product (degree 4 budget)
 @dataclass(frozen=True)
 class CircuitConfig:
     """Circuit shape — the pinning payload (reference: `Eth2ConfigPinning`
-    {k, num_advice, lookup_bits, ...}, `util/circuit.rs:55-78`)."""
+    {k, num_advice, lookup_bits, ...}, `util/circuit.rs:55-78`).
+
+    lookup_tables: table id per lookup-advice column. "range" is the
+    [0, 2^lookup_bits) table; "nibble_op" packs 4-bit XOR/AND triples
+    (op<<12 | x<<8 | y<<4 | result) for the SHA chip. Empty tuple means
+    num_lookup_advice columns of "range" (back-compat)."""
 
     k: int
     num_advice: int
@@ -40,6 +45,7 @@ class CircuitConfig:
     num_fixed: int
     lookup_bits: int
     num_instance: int = 1
+    lookup_tables: tuple = ()
 
     @property
     def n(self) -> int:
@@ -73,10 +79,20 @@ class CircuitConfig:
     def col_instance(self, j):
         return self.num_advice + self.num_lookup_advice + self.num_fixed + j
 
+    def table_id(self, j: int) -> str:
+        if self.lookup_tables:
+            return self.lookup_tables[j]
+        return "range"
+
     def validate(self):
         assert self.lookup_bits < self.k, "table must fit the usable rows"
         assert (1 << self.lookup_bits) <= self.usable_rows
         assert self.num_instance >= 1
+        if self.lookup_tables:
+            assert len(self.lookup_tables) == self.num_lookup_advice
+        if "nibble_op" in (self.lookup_tables or ()):
+            assert 2 * 16 * 16 <= self.usable_rows, \
+                "nibble_op table (512 rows) does not fit usable rows"
 
 
 @dataclass
@@ -101,10 +117,28 @@ class Assignment:
         return col
 
 
-def table_column(cfg: CircuitConfig) -> list:
-    """The range table fixed polynomial: 0..2^lookup_bits-1, padded by zeros
-    (zero is a table member, so padding rows remain valid table entries)."""
-    vals = list(range(1 << cfg.lookup_bits))
+def table_column(cfg: CircuitConfig, table_id: str = "range") -> list:
+    """Table fixed polynomials, zero-padded (zero is a member of every table,
+    so padding rows remain valid entries).
+
+    "range":     0..2^lookup_bits-1
+    "nibble_op": packed 4-bit bitwise triples — (op << 12) | (x << 8) |
+                 (y << 4) | f_op(x, y), op 0 = XOR, op 1 = AND. The SHA chip
+                 proves z = x op y by asserting membership of the packed
+                 value (the TPU-era answer to the reference's spread-table
+                 custom gates: pure lookups, no custom region)."""
+    if table_id == "range":
+        vals = list(range(1 << cfg.lookup_bits))
+    elif table_id == "nibble_op":
+        vals = []
+        for x in range(16):
+            for y in range(16):
+                vals.append((0 << 12) | (x << 8) | (y << 4) | (x ^ y))
+        for x in range(16):
+            for y in range(16):
+                vals.append((1 << 12) | (x << 8) | (y << 4) | (x & y))
+    else:
+        raise KeyError(table_id)
     vals += [0] * (cfg.n - len(vals))
     return vals
 

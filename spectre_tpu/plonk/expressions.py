@@ -76,7 +76,7 @@ def all_expressions(cfg: CircuitConfig, c, beta: int, gamma: int):
         pa = c.var(("pA", j), 0)
         pa_prev = c.var(("pA", j), -1)
         pt = c.var(("pT", j), 0)
-        tab = c.var(("tab", 0), 0)
+        tab = c.var(("tab", j), 0)
         lz = c.var(("lz", j), 0)
         lz1 = c.var(("lz", j), 1)
         exprs.append(c.mul(c.l0, c.sub(lz, one)))

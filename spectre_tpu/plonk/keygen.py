@@ -31,7 +31,7 @@ class VerifyingKey:
     selector_commits: list
     fixed_commits: list
     sigma_commits: list
-    table_commit: object
+    table_commits: list    # one per lookup-advice column (cfg.table_id(j))
 
     @property
     def domain(self) -> Domain:
@@ -43,8 +43,9 @@ class VerifyingKey:
         cfg = self.config
         h.update(repr((cfg.k, cfg.num_advice, cfg.num_lookup_advice, cfg.num_fixed,
                        cfg.lookup_bits, cfg.num_instance)).encode())
+        h.update(repr(cfg.lookup_tables).encode())
         for pt in (self.selector_commits + self.fixed_commits
-                   + self.sigma_commits + [self.table_commit]):
+                   + self.sigma_commits + self.table_commits):
             h.update(bn254.g1_to_bytes(pt))
         return h.digest()
 
@@ -73,7 +74,8 @@ class VerifyingKey:
             plan.append((("fix", j), 0))
         for j in range(cfg.num_perm_columns):
             plan.append((("sig", j), 0))
-        plan.append((("tab", 0), 0))
+        for j in range(cfg.num_lookup_advice):
+            plan.append((("tab", j), 0))
         for i in range(3):
             plan.append((("h", i), 0))
         return plan
@@ -93,12 +95,12 @@ class ProvingKey:
     selector_polys: list      # coefficient form [n,4] arrays
     fixed_polys: list
     sigma_polys: list
-    table_poly: np.ndarray
+    table_polys: list         # one per lookup-advice column
     # lagrange (value) forms kept for prover-side products
     selector_values: list
     fixed_values: list
     sigma_values: list        # int lists
-    table_values: list
+    table_values: list        # one list per lookup-advice column
 
 
 def keygen(srs: SRS, cfg: CircuitConfig, fixed_columns: list, selectors: list,
@@ -114,7 +116,10 @@ def keygen(srs: SRS, cfg: CircuitConfig, fixed_columns: list, selectors: list,
 
     sel_vals = [list(map(int, s)) for s in selectors]
     fix_vals = [list(map(int, f)) for f in fixed_columns]
-    tab_vals = table_column(cfg)
+    # one table build per DISTINCT table id; columns share the objects
+    tab_by_id = {tid: table_column(cfg, tid)
+                 for tid in {cfg.table_id(j) for j in range(cfg.num_lookup_advice)}}
+    tab_vals = [tab_by_id[cfg.table_id(j)] for j in range(cfg.num_lookup_advice)]
     sigma_vals = build_sigma(cfg, copies)
 
     def to_poly(vals):
@@ -123,14 +128,19 @@ def keygen(srs: SRS, cfg: CircuitConfig, fixed_columns: list, selectors: list,
     sel_polys = [to_poly(v) for v in sel_vals]
     fix_polys = [to_poly(v) for v in fix_vals]
     sig_polys = [to_poly(v) for v in sigma_vals]
-    tab_poly = to_poly(tab_vals)
+    tab_poly_by_id = {tid: to_poly(v) for tid, v in tab_by_id.items()}
+    tab_polys = [tab_poly_by_id[cfg.table_id(j)]
+                 for j in range(cfg.num_lookup_advice)]
+    tab_commit_by_id = {tid: kzg.commit(srs, p, bk)
+                        for tid, p in tab_poly_by_id.items()}
 
     vk = VerifyingKey(
         config=cfg,
         selector_commits=[kzg.commit(srs, p, bk) for p in sel_polys],
         fixed_commits=[kzg.commit(srs, p, bk) for p in fix_polys],
         sigma_commits=[kzg.commit(srs, p, bk) for p in sig_polys],
-        table_commit=kzg.commit(srs, tab_poly, bk),
+        table_commits=[tab_commit_by_id[cfg.table_id(j)]
+                       for j in range(cfg.num_lookup_advice)],
     )
-    return ProvingKey(vk, sel_polys, fix_polys, sig_polys, tab_poly,
+    return ProvingKey(vk, sel_polys, fix_polys, sig_polys, tab_polys,
                       sel_vals, fix_vals, sigma_vals, tab_vals)

@@ -73,7 +73,8 @@ def mock_prove(cfg: CircuitConfig, assignment: Assignment, fixed_values=None,
     fixed_values = fixed_values or [list(map(int, f)) for f in assignment.fixed]
     selector_values = selector_values or [list(map(int, s)) for s in assignment.selectors]
     sigma_values = sigma_values or build_sigma(cfg, assignment.copies)
-    table_values = table_values or table_column(cfg)
+    table_values = table_values or [table_column(cfg, cfg.table_id(j))
+                                    for j in range(cfg.num_lookup_advice)]
 
     # --- direct checks first (better error messages than the polynomial ones) ---
     def cell(col_idx, row):
@@ -89,8 +90,8 @@ def mock_prove(cfg: CircuitConfig, assignment: Assignment, fixed_values=None,
         va, vb = cell(ca, ra), cell(cb, rb)
         assert va == vb, f"copy constraint violated: col{ca}[{ra}]={va} != col{cb}[{rb}]={vb}"
 
-    table_set = set(int(v) % R for v in table_values[:u])
     for j, col in enumerate(assignment.lookup_advice):
+        table_set = set(int(v) % R for v in table_values[j][:u])
         for i in range(u):
             v = int(col[i]) % R
             assert v in table_set, f"lookup col {j} row {i}: {v} not in table"
@@ -108,7 +109,8 @@ def mock_prove(cfg: CircuitConfig, assignment: Assignment, fixed_values=None,
         columns[("q", j)] = [int(x) % R for x in v]
     for j, v in enumerate(sigma_values):
         columns[("sig", j)] = [int(x) % R for x in v]
-    columns[("tab", 0)] = [int(x) % R for x in table_values]
+    for j in range(cfg.num_lookup_advice):
+        columns[("tab", j)] = [int(x) % R for x in table_values[j]]
     for j in range(cfg.num_instance):
         columns[("inst", j)] = assignment.instance_column(j)
 
@@ -140,7 +142,7 @@ def mock_prove(cfg: CircuitConfig, assignment: Assignment, fixed_values=None,
     assert prev_end == 1, "permutation grand product != 1"
 
     for j in range(cfg.num_lookup_advice):
-        pa, pt = permute_lookup(cfg, columns[("ladv", j)], table_values)
+        pa, pt = permute_lookup(cfg, columns[("ladv", j)], table_values[j])
         columns[("pA", j)] = pa
         columns[("pT", j)] = pt
         z = [0] * n
@@ -148,7 +150,7 @@ def mock_prove(cfg: CircuitConfig, assignment: Assignment, fixed_values=None,
         for i in range(n):
             if i + 1 < n:
                 if i < u:
-                    num = (columns[("ladv", j)][i] + beta) % R * ((table_values[i] + gamma) % R) % R
+                    num = (columns[("ladv", j)][i] + beta) % R * ((table_values[j][i] + gamma) % R) % R
                     den = (pa[i] + beta) % R * ((pt[i] + gamma) % R) % R
                     z[i + 1] = z[i] * num % R * pow(den, -1, R) % R
                 else:

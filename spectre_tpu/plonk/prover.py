@@ -14,6 +14,7 @@ import numpy as np
 
 from ..fields import bn254
 from ..native import host
+from ..utils.profiling import phase
 from . import backend as B, kzg
 from .constraint_system import Assignment, PERM_CHUNK, permute_lookup
 from .domain import DELTA, Domain
@@ -103,16 +104,18 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
         pt = kzg.commit(srs, coeffs, bk)
         tr.write_point(pt)
 
-    for j, v in enumerate(adv_vals):
-        commit_col(("adv", j), v)
-    for j, v in enumerate(ladv_vals):
-        commit_col(("ladv", j), v)
+    with phase("prove/commit_advice"):
+        for j, v in enumerate(adv_vals):
+            commit_col(("adv", j), v)
+        for j, v in enumerate(ladv_vals):
+            commit_col(("ladv", j), v)
 
     # --- 2. lookup permuted columns ---
-    for j in range(cfg.num_lookup_advice):
-        pa, pt_col = permute_lookup(cfg, ladv_vals[j], pk.table_values)
-        commit_col(("pA", j), pa)
-        commit_col(("pT", j), pt_col)
+    with phase("prove/lookup_permute"):
+        for j in range(cfg.num_lookup_advice):
+            pa, pt_col = permute_lookup(cfg, ladv_vals[j], pk.table_values[j])
+            commit_col(("pA", j), pa)
+            commit_col(("pT", j), pt_col)
 
     beta = tr.challenge()
     gamma = tr.challenge()
@@ -164,7 +167,7 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
     # --- 4. lookup grand products ---
     for j in range(cfg.num_lookup_advice):
         a_v, pa_v, pt_v = values[("ladv", j)], values[("pA", j)], values[("pT", j)]
-        t_v = pk.table_values
+        t_v = pk.table_values[j]
         num = bk.mul(bk.add(B.to_arr(a_v), B.to_arr([beta] * n)),
                      bk.add(B.to_arr(t_v), B.to_arr([gamma] * n)))
         den = bk.mul(bk.add(B.to_arr(pa_v), B.to_arr([beta] * n)),
@@ -193,7 +196,7 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
             elif key[0] == "sig":
                 ext_cache[key] = dom.coeff_to_extended(pk.sigma_polys[key[1]], bk)
             elif key[0] == "tab":
-                ext_cache[key] = dom.coeff_to_extended(pk.table_poly, bk)
+                ext_cache[key] = dom.coeff_to_extended(pk.table_polys[key[1]], bk)
             elif key[0] == "inst":
                 coeffs = dom.lagrange_to_coeff(B.to_arr(inst_vals[key[1]]), bk)
                 polys[key] = coeffs
@@ -224,16 +227,19 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
     ctx.llast = dom.coeff_to_extended(dom.lagrange_to_coeff(B.to_arr(llast_vals), bk), bk)
     ctx.lblind = dom.coeff_to_extended(dom.lagrange_to_coeff(B.to_arr(lblind_vals), bk), bk)
 
-    exprs = all_expressions(cfg, ctx, beta, gamma)
-    acc = None
-    for e in exprs:
-        acc = e if acc is None else bk.add(bk.scale(acc, y), e)
-    h_evals = bk.mul(acc, dom.vanishing_inv_on_extended())
-    h_coeffs = dom.extended_to_coeff(h_evals, bk)
-    # degree sanity: deg h <= 3n-4, so the top chunk must vanish — a nonzero
-    # tail means a constraint exceeded the degree-4 budget (silent truncation
-    # here would emit unverifiable proofs with no diagnostic)
-    assert not np.any(h_coeffs[3 * n:]), "quotient degree exceeds budget"
+    with phase("prove/quotient"):
+        exprs = all_expressions(cfg, ctx, beta, gamma)
+        acc = None
+        for e in exprs:
+            acc = e if acc is None else bk.add(bk.scale(acc, y), e)
+        h_evals = bk.mul(acc, dom.vanishing_inv_on_extended())
+        h_coeffs = dom.extended_to_coeff(h_evals, bk)
+    # deg h <= 3n-4, so the top chunk must vanish. A nonzero tail means the
+    # division by the vanishing polynomial was inexact: either the witness
+    # violates a constraint, or an expression exceeded the degree-4 budget.
+    # Refusing here beats silently emitting an unverifiable proof.
+    assert not np.any(h_coeffs[3 * n:]), \
+        "quotient not a polynomial: witness violates constraints (or degree budget exceeded)"
     for i in range(3):
         chunk = h_coeffs[i * n:(i + 1) * n]
         if chunk.shape[0] < n:
@@ -257,25 +263,27 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
         if kind == "sig":
             return pk.sigma_polys[j]
         if kind == "tab":
-            return pk.table_poly
+            return pk.table_polys[j]
         raise KeyError(key)
 
-    evals = {}
-    for key, rot in plan:
-        pt = pk.vk.rotation_point(x, rot)
-        ev = host.fp_horner(host.FR, poly_for(key), pt)
-        evals[(key, rot)] = ev
-        tr.write_scalar(ev)
+    with phase("prove/evals"):
+        evals = {}
+        for key, rot in plan:
+            pt = pk.vk.rotation_point(x, rot)
+            ev = host.fp_horner(host.FR, poly_for(key), pt)
+            evals[(key, rot)] = ev
+            tr.write_scalar(ev)
 
     # --- 7. SHPLONK multiopen ---
     by_key: dict = {}
     for key, rot in plan:
         by_key.setdefault(key, []).append(rot)
-    entries = []
-    for key, rots in by_key.items():
-        pts = tuple(pk.vk.rotation_point(x, r) for r in rots)
-        evs = tuple(evals[(key, r)] for r in rots)
-        entries.append(kzg.OpenEntry(poly_for(key), None, pts, evs))
-    kzg.shplonk_open(srs, dom, entries, tr, bk)
+    with phase("prove/multiopen"):
+        entries = []
+        for key, rots in by_key.items():
+            pts = tuple(pk.vk.rotation_point(x, r) for r in rots)
+            evs = tuple(evals[(key, r)] for r in rots)
+            entries.append(kzg.OpenEntry(poly_for(key), None, pts, evs))
+        kzg.shplonk_open(srs, dom, entries, tr, bk)
 
     return tr.finalize()

@@ -81,9 +81,9 @@ def verify(vk: VerifyingKey, srs: SRS, instances: list, proof: bytes,
         return False
 
     # --- SHPLONK ---
-    fixed_commits = {
-        ("tab", 0): vk.table_commit,
-    }
+    fixed_commits = {}
+    for j, c in enumerate(vk.table_commits):
+        fixed_commits[("tab", j)] = c
     for j, c in enumerate(vk.selector_commits):
         fixed_commits[("q", j)] = c
     for j, c in enumerate(vk.fixed_commits):

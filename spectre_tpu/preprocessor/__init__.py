@@ -1,0 +1,12 @@
+"""Witness acquisition: Beacon-chain REST -> circuit witnesses.
+
+Reference parity (SURVEY.md L4): `preprocessor/src/` — fetchers for
+LightClientFinalityUpdate / LightClientUpdate / Bootstrap and converters to
+SyncStepArgs / CommitteeUpdateArgs, with NATIVE verification of the merkle
+branches and the aggregate signature before proving
+(`step.rs:90-120`, `rotation.rs:105-118`).
+"""
+
+from .beacon import BeaconClient  # noqa: F401
+from .step import step_args_from_finality_update  # noqa: F401
+from .rotation import rotation_args_from_update  # noqa: F401

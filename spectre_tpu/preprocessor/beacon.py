@@ -1,0 +1,41 @@
+"""Minimal Beacon-chain REST client (stdlib urllib; no external deps).
+
+Reference parity: the `beacon-api-client` usage in `preprocessor/src/lib.rs`:
+light-client endpoints for finality updates, committee updates and bootstrap.
+Network egress may be unavailable in dev environments; everything above this
+client consumes plain dicts, so tests inject fixtures instead.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+
+class BeaconClient:
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _get(self, path: str) -> dict:
+        req = urllib.request.Request(self.base_url + path,
+                                     headers={"Accept": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.load(resp)
+
+    def finality_update(self) -> dict:
+        return self._get("/eth/v1/beacon/light_client/finality_update")["data"]
+
+    def committee_updates(self, period: int, count: int = 1) -> list[dict]:
+        data = self._get(f"/eth/v1/beacon/light_client/updates"
+                         f"?start_period={period}&count={count}")
+        return [d["data"] for d in data] if isinstance(data, list) else [data["data"]]
+
+    def bootstrap(self, block_root: str) -> dict:
+        return self._get(f"/eth/v1/beacon/light_client/bootstrap/{block_root}")["data"]
+
+    def head_block_root(self) -> str:
+        return self._get("/eth/v1/beacon/blocks/head/root")["data"]["root"]
+
+    def sync_period(self, spec, slot: int) -> int:
+        return spec.sync_period(slot)

@@ -1,0 +1,7 @@
+"""Prover service: CLI, JSON-RPC server/client, preloaded prover state.
+
+Reference parity (SURVEY.md L5): `prover/src/` — clap CLI (`args.rs`,
+`cli.rs`), axum JSON-RPC server with `genEvmProof_*` methods (`rpc.rs`,
+`rpc_api.rs`), boot-time `ProverState` (`prover.rs:43-117`), typed client
+(`rpc_client.rs`), `utils committee-poseidon` (`utils.rs`).
+"""

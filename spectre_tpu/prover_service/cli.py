@@ -1,0 +1,140 @@
+"""spectre-tpu prover CLI.
+
+Reference parity: `prover/src/args.rs:32-170` + `cli.rs:35-242`:
+  circuit {sync-step,committee-update} setup   -- SRS + pk generation
+  circuit ... prove                            -- prove a witness file
+  rpc                                          -- serve the JSON-RPC API
+  utils committee-poseidon                     -- deployment bootstrap values
+plus `--backend {cpu,tpu}` (the BASELINE.json north-star selection point) and
+`--spec {minimal,testnet,mainnet}` network dispatch (`main.rs:27-57`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .. import spec as spec_mod
+
+
+def _spec(name):
+    return spec_mod.SPECS[name]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="spectre-tpu")
+    p.add_argument("--spec", default="minimal", choices=list(spec_mod.SPECS))  # incl. "tiny" demo net
+    p.add_argument("--backend", default="cpu", choices=["cpu", "tpu"])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("circuit", help="circuit lifecycle")
+    c.add_argument("which", choices=["sync-step", "committee-update"])
+    c.add_argument("action", choices=["setup", "prove", "verify"])
+    c.add_argument("--k", type=int, default=17)
+    c.add_argument("--witness", help="witness JSON path (default: mock witness)")
+    c.add_argument("--proof-out", default="proof.bin")
+    c.add_argument("--proof-in")
+
+    r = sub.add_parser("rpc", help="serve JSON-RPC prover API")
+    r.add_argument("--host", default="127.0.0.1")
+    r.add_argument("--port", type=int, default=3000)
+    r.add_argument("--k-step", type=int, default=17)
+    r.add_argument("--k-committee", type=int, default=17)
+    r.add_argument("--concurrency", type=int, default=1)
+
+    u = sub.add_parser("utils", help="deployment utilities")
+    u.add_argument("util", choices=["committee-poseidon"])
+    u.add_argument("--beacon-api", help="Beacon REST base URL")
+
+    b = sub.add_parser("bench", help="run the MSM benchmark")
+
+    args = p.parse_args(argv)
+    spec = _spec(args.spec)
+
+    if args.cmd == "circuit":
+        _circuit_cmd(args, spec)
+    elif args.cmd == "rpc":
+        from .rpc import serve
+        from .state import ProverState
+        print(f"loading prover state (spec={spec.name}, backend={args.backend})...",
+              flush=True)
+        state = ProverState(spec, args.k_step, args.k_committee,
+                            args.concurrency, args.backend)
+        print(f"serving on {args.host}:{args.port}", flush=True)
+        serve(state, args.host, args.port)
+    elif args.cmd == "utils":
+        _utils_cmd(args, spec)
+    elif args.cmd == "bench":
+        import subprocess
+        subprocess.run([sys.executable, "bench.py"], check=True)
+
+
+def _circuit_cmd(args, spec):
+    from ..models import CommitteeUpdateCircuit, StepCircuit
+    from ..plonk import backend as B
+    from ..plonk.srs import SRS
+    from ..witness import default_committee_update_args, default_sync_step_args
+
+    circuit = StepCircuit if args.which == "sync-step" else CommitteeUpdateCircuit
+    default_args = (default_sync_step_args if args.which == "sync-step"
+                    else default_committee_update_args)(spec)
+    bk = B.get_backend(args.backend)
+    srs = SRS.load_or_setup(args.k)
+
+    if args.action == "setup":
+        pk = circuit.create_pk(srs, spec, args.k, default_args, bk)
+        print(f"pk ready: {circuit.pinning_path(spec, args.k)}")
+        return
+
+    witness_args = default_args
+    if args.witness:
+        with open(args.witness) as f:
+            data = json.load(f)
+        witness_args = _witness_from_json(args.which, data)
+
+    pk = circuit.create_pk(srs, spec, args.k, default_args, bk)
+    if args.action == "prove":
+        proof = circuit.prove(pk, srs, witness_args, spec, bk)
+        with open(args.proof_out, "wb") as f:
+            f.write(proof)
+        instances = circuit.get_instances(witness_args, spec)
+        print(json.dumps({"proof": args.proof_out, "bytes": len(proof),
+                          "instances": [hex(v) for v in instances]}))
+    elif args.action == "verify":
+        with open(args.proof_in or args.proof_out, "rb") as f:
+            proof = f.read()
+        instances = circuit.get_instances(witness_args, spec)
+        ok = circuit.verify(pk.vk, srs, instances, proof)
+        print(json.dumps({"valid": bool(ok)}))
+        sys.exit(0 if ok else 1)
+
+
+def _witness_from_json(which: str, data: dict):
+    from ..preprocessor.rotation import rotation_args_from_update
+    from ..preprocessor.step import step_args_from_finality_update
+    if which == "sync-step":
+        raise SystemExit("sync-step witness JSON requires the update+pubkeys "
+                         "format; use the rpc API or the preprocessor directly")
+    return rotation_args_from_update(data, _spec(data.get("spec", "minimal")))
+
+
+def _utils_cmd(args, spec):
+    from ..fields import bls12_381 as bls
+    from ..gadgets.poseidon_commit import committee_poseidon_from_uncompressed
+    from .beacon_helpers import fetch_bootstrap_committee
+
+    assert args.util == "committee-poseidon"
+    assert args.beacon_api, "--beacon-api required"
+    period, root, pubkeys = fetch_bootstrap_committee(args.beacon_api, spec)
+    pts = [bls.g1_decompress(pk) for pk in pubkeys]
+    commitment = committee_poseidon_from_uncompressed(pts)
+    print(json.dumps({
+        "sync_period": period,
+        "committee_ssz_root": "0x" + root.hex(),
+        "committee_poseidon": hex(commitment),
+    }))
+
+
+if __name__ == "__main__":
+    main()

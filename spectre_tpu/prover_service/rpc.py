@@ -1,0 +1,99 @@
+"""JSON-RPC 2.0 server (stdlib http.server; no framework deps).
+
+Reference parity: `prover/src/rpc.rs` + `rpc_api.rs:8-36` — POST /rpc with
+methods `genEvmProof_SyncStepCompressed` and
+`genEvmProof_CommitteeUpdateCompressed`; responses carry proof + instances
+(calldata-shaped); the committee variant additionally surfaces the committee
+poseidon commitment (`rpc.rs:106`).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..preprocessor.rotation import rotation_args_from_update
+from ..preprocessor.step import step_args_from_finality_update
+from .calldata import encode_calldata
+from .state import ProverState
+
+RPC_METHOD_STEP = "genEvmProof_SyncStepCompressed"
+RPC_METHOD_COMMITTEE = "genEvmProof_CommitteeUpdateCompressed"
+
+
+def _error(code, message, id_=None):
+    return {"jsonrpc": "2.0", "error": {"code": code, "message": message}, "id": id_}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: ProverState = None  # class attr injected by serve()
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def do_POST(self):
+        if self.path not in ("/rpc", "/"):
+            self.send_error(404)
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length))
+            resp = self._dispatch(req)
+        except Exception as exc:  # malformed request
+            resp = _error(-32700, f"parse error: {exc}")
+        body = json.dumps(resp).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _dispatch(self, req: dict) -> dict:
+        id_ = req.get("id")
+        method = req.get("method")
+        params = req.get("params") or {}
+        try:
+            if method == RPC_METHOD_STEP:
+                spec = self.state.spec
+                args = step_args_from_finality_update(
+                    params["light_client_finality_update"],
+                    params["pubkeys"],
+                    bytes.fromhex(params["domain"].removeprefix("0x")),
+                    spec)
+                proof, instances = self.state.prove_step(args)
+                result = {
+                    "proof": "0x" + proof.hex(),
+                    "instances": [hex(v) for v in instances],
+                    "calldata": "0x" + encode_calldata(instances, proof).hex(),
+                }
+            elif method == RPC_METHOD_COMMITTEE:
+                args = rotation_args_from_update(
+                    params["light_client_update"], self.state.spec)
+                proof, instances = self.state.prove_committee(args)
+                result = {
+                    "proof": "0x" + proof.hex(),
+                    "instances": [hex(v) for v in instances],
+                    "calldata": "0x" + encode_calldata(instances, proof).hex(),
+                    "committee_poseidon": hex(instances[0]),
+                }
+            elif method == "ping":
+                result = "pong"
+            else:
+                return _error(-32601, f"unknown method {method}", id_)
+        except AssertionError as exc:
+            return _error(-32000, f"witness rejected: {exc}", id_)
+        except KeyError as exc:
+            return _error(-32602, f"missing param: {exc}", id_)
+        return {"jsonrpc": "2.0", "result": result, "id": id_}
+
+
+def serve(state: ProverState, host: str = "127.0.0.1", port: int = 3000,
+          background: bool = False):
+    _Handler.state = state
+    server = ThreadingHTTPServer((host, port), _Handler)
+    if background:
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        return server
+    server.serve_forever()

@@ -1,0 +1,41 @@
+"""Typed JSON-RPC client (reference parity: `prover/src/rpc_client.rs:39-93`)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from .rpc import RPC_METHOD_COMMITTEE, RPC_METHOD_STEP
+
+
+class ProverClient:
+    def __init__(self, url: str, timeout: float = 3600.0):
+        self.url = url
+        self.timeout = timeout
+        self._id = 0
+
+    def _call(self, method: str, params: dict):
+        self._id += 1
+        body = json.dumps({"jsonrpc": "2.0", "method": method,
+                           "params": params, "id": self._id}).encode()
+        req = urllib.request.Request(
+            self.url, data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            data = json.load(resp)
+        if "error" in data:
+            raise RuntimeError(f"rpc error: {data['error']}")
+        return data["result"]
+
+    def ping(self) -> str:
+        return self._call("ping", {})
+
+    def gen_evm_proof_sync_step_compressed(self, finality_update: dict,
+                                           pubkeys: list, domain: str):
+        return self._call(RPC_METHOD_STEP, {
+            "light_client_finality_update": finality_update,
+            "pubkeys": pubkeys,
+            "domain": domain,
+        })
+
+    def gen_evm_proof_committee_update_compressed(self, update: dict):
+        return self._call(RPC_METHOD_COMMITTEE, {"light_client_update": update})

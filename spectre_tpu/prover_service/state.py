@@ -1,0 +1,49 @@
+"""ProverState: SRS + proving keys loaded once at boot.
+
+Reference parity: `prover/src/prover.rs:43-117` (`ProverState::new`: SRS map
+by degree, pkeys for step/committee circuits created from default witnesses)
+and the semaphore-based concurrency cap (`prover.rs:40`) — here a
+threading.Semaphore, acquired by the RPC handlers.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .. import spec as spec_mod
+from ..models import CommitteeUpdateCircuit, StepCircuit
+from ..plonk import backend as B
+from ..plonk.srs import SRS
+from ..witness import default_committee_update_args, default_sync_step_args
+
+
+class ProverState:
+    def __init__(self, spec, k_step: int, k_committee: int,
+                 concurrency: int = 1, backend: str = "cpu",
+                 params_dir: str | None = None):
+        self.spec = spec
+        self.backend = B.get_backend(backend)
+        self.semaphore = threading.Semaphore(concurrency)
+        self.srs = {}
+        for k in {k_step, k_committee}:
+            self.srs[k] = SRS.load_or_setup(k, params_dir)
+        self.k_step, self.k_committee = k_step, k_committee
+        self.step_pk = StepCircuit.create_pk(
+            self.srs[k_step], spec, k_step,
+            default_sync_step_args(spec), self.backend)
+        self.committee_pk = CommitteeUpdateCircuit.create_pk(
+            self.srs[k_committee], spec, k_committee,
+            default_committee_update_args(spec), self.backend)
+
+    def prove_step(self, args) -> tuple[bytes, list]:
+        with self.semaphore:
+            proof = StepCircuit.prove(self.step_pk, self.srs[self.k_step],
+                                      args, self.spec, self.backend)
+        return proof, StepCircuit.get_instances(args, self.spec)
+
+    def prove_committee(self, args) -> tuple[bytes, list]:
+        with self.semaphore:
+            proof = CommitteeUpdateCircuit.prove(
+                self.committee_pk, self.srs[self.k_committee], args,
+                self.spec, self.backend)
+        return proof, CommitteeUpdateCircuit.get_instances(args, self.spec)

@@ -92,7 +92,23 @@ MAINNET = Spec(
     finalized_header_depth=6,
 )
 
-SPECS = {s.name: s for s in (MINIMAL, TESTNET, MAINNET)}
+# A 2-validator demo network for fast end-to-end runs (not in the reference;
+# the circuits are size-generic, so this exercises every constraint at the
+# smallest shape).
+TINY = Spec(
+    name="tiny",
+    sync_committee_size=2,
+    sync_committee_depth=5,
+    sync_committee_root_index=55,
+    execution_state_root_index=9,
+    execution_state_root_depth=4,
+    finalized_header_index=105,
+    finalized_header_depth=6,
+    slots_per_epoch=8,
+    epochs_per_sync_committee_period=8,
+)
+
+SPECS = {s.name: s for s in (TINY, MINIMAL, TESTNET, MAINNET)}
 
 
 # Circuit bigint shape for non-native BLS12-381 Fq over BN254 Fr

@@ -1,0 +1,3 @@
+"""Utilities: circuit pinning, artifact caching."""
+
+from .pinning import Pinning  # noqa: F401

@@ -1,0 +1,52 @@
+"""Circuit-shape pinning: freeze (k, columns, tables, break points) to JSON.
+
+Reference parity: `Halo2ConfigPinning` / `Eth2ConfigPinning`
+(`util/circuit.rs:26-78`) + the JSON files under `lightclient-circuits/
+config/` — the reproducible-prover-setup system: the prover re-creates the
+circuit from pinning per request, never re-deriving the layout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass
+
+from ..plonk.constraint_system import CircuitConfig
+
+
+@dataclass
+class Pinning:
+    config: CircuitConfig
+    break_points: list
+
+    def write(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({
+                "config": {**asdict(self.config),
+                           "lookup_tables": list(self.config.lookup_tables)},
+                "break_points": self.break_points,
+            }, f, indent=1)
+
+    @classmethod
+    def read(cls, path: str) -> "Pinning":
+        with open(path) as f:
+            data = json.load(f)
+        c = data["config"]
+        c["lookup_tables"] = tuple(c.get("lookup_tables") or ())
+        return cls(CircuitConfig(**c), data["break_points"])
+
+    @classmethod
+    def load_or_create(cls, path: str, ctx, k: int, lookup_bits: int) -> "Pinning":
+        """Use the pinned shape if present; otherwise auto-size from the
+        context and persist (reference: written on first keygen,
+        `util/circuit.rs:132-135`)."""
+        if path and os.path.exists(path):
+            return cls.read(path)
+        cfg = ctx.auto_config(k=k, lookup_bits=lookup_bits)
+        _, _, _, _, _, _, bp = ctx.layout(cfg)
+        pin = cls(cfg, bp)
+        if path:
+            pin.write(path)
+        return pin

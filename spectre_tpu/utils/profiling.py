@@ -1,0 +1,51 @@
+"""Phase timers + structured logging for the prover pipeline.
+
+Reference parity (SURVEY.md §5): ark-std `start_timer!/end_timer!` under the
+`print-trace` feature + `RUST_LOG` env filtering. Here: `phase(...)` context
+managers emit wall-clock per prover phase when SPECTRE_TRACE=1 (or via
+logging at DEBUG), and a process-wide registry accumulates totals so services
+can expose them (the JSON-RPC server reports them under `ping`-style
+diagnostics).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+from collections import defaultdict
+
+log = logging.getLogger("spectre_tpu")
+
+_TOTALS: dict[str, float] = defaultdict(float)
+_COUNTS: dict[str, int] = defaultdict(int)
+
+
+def trace_enabled() -> bool:
+    return os.environ.get("SPECTRE_TRACE", "") not in ("", "0")
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    """Time a prover phase; nestable."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        _TOTALS[name] += dt
+        _COUNTS[name] += 1
+        if trace_enabled():
+            print(f"[trace] {name}: {dt * 1000:.1f} ms", flush=True)
+        log.debug("phase %s: %.1f ms", name, dt * 1000)
+
+
+def totals() -> dict:
+    return {k: {"seconds": round(v, 4), "count": _COUNTS[k]}
+            for k, v in sorted(_TOTALS.items())}
+
+
+def reset():
+    _TOTALS.clear()
+    _COUNTS.clear()

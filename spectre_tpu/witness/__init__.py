@@ -1,0 +1,10 @@
+"""Witness types and builders for the application circuits.
+
+Reference parity: `lightclient-circuits/src/witness/` — `SyncStepArgs`
+(`witness/step.rs:28-49`), `CommitteeUpdateArgs` (`witness/rotation.rs:16-25`)
+and their Default (self-signed / mock-rooted) constructions used by tests.
+"""
+
+from .types import BeaconBlockHeader, CommitteeUpdateArgs, SyncStepArgs  # noqa: F401
+from .rotation import default_committee_update_args  # noqa: F401
+from .step import default_sync_step_args  # noqa: F401

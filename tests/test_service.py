@@ -1,0 +1,272 @@
+"""Service layer: preprocessor converters, RPC plumbing, contracts, fixtures."""
+
+import dataclasses
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from spectre_tpu import spec as SP
+from spectre_tpu.contracts import MockVerifier, SpectreContract
+from spectre_tpu.contracts.spectre import StepInput
+from spectre_tpu.models import CommitteeUpdateCircuit, StepCircuit
+from spectre_tpu.preprocessor.rotation import rotation_args_from_update
+from spectre_tpu.preprocessor.step import step_args_from_finality_update
+from spectre_tpu.prover_service.calldata import decode_calldata, encode_calldata
+from spectre_tpu.witness import (
+    default_committee_update_args,
+    default_sync_step_args,
+)
+from spectre_tpu.test_utils import (
+    dump_rotation_fixture,
+    dump_step_fixture,
+    load_rotation_fixture,
+    load_step_fixture,
+)
+
+TINY = dataclasses.replace(SP.MINIMAL, name="tiny", sync_committee_size=2)
+
+
+def _hdr_dict(h):
+    return {"slot": h.slot, "proposer_index": h.proposer_index,
+            "parent_root": "0x" + h.parent_root.hex(),
+            "state_root": "0x" + h.state_root.hex(),
+            "body_root": "0x" + h.body_root.hex()}
+
+
+class TestPreprocessor:
+    def test_step_converter_roundtrip(self):
+        from spectre_tpu.fields import bls12_381 as bls
+        args = default_sync_step_args(TINY)
+        pks = [bls.g1_compress((bls.Fq(x), bls.Fq(y)))
+               for x, y in args.pubkeys_uncompressed]
+        update = {
+            "attested_header": _hdr_dict(args.attested_header),
+            "finalized_header": _hdr_dict(args.finalized_header),
+            "finality_branch": ["0x" + b.hex() for b in args.finality_branch],
+            "execution_payload_root": "0x" + args.execution_payload_root.hex(),
+            "execution_branch": ["0x" + b.hex() for b in args.execution_payload_branch],
+            "sync_aggregate": {
+                "sync_committee_bits": args.participation_bits,
+                "sync_committee_signature": "0x" + args.signature_compressed.hex(),
+            },
+        }
+        rebuilt = step_args_from_finality_update(
+            update, pks, args.domain, TINY)
+        assert rebuilt.signing_root() == args.signing_root()
+        assert StepCircuit.get_instances(rebuilt, TINY) == \
+            StepCircuit.get_instances(args, TINY)
+
+    def test_step_converter_rejects_bad_branch(self):
+        args = default_sync_step_args(TINY)
+        update = {
+            "attested_header": _hdr_dict(args.attested_header),
+            "finalized_header": _hdr_dict(args.finalized_header),
+            "finality_branch": ["0x" + b"\x00".hex() * 32
+                                for _ in args.finality_branch],
+            "execution_payload_root": "0x" + args.execution_payload_root.hex(),
+            "execution_branch": ["0x" + b.hex() for b in args.execution_payload_branch],
+            "sync_aggregate": {"sync_committee_bits": args.participation_bits,
+                               "sync_committee_signature": "0x" + args.signature_compressed.hex()},
+        }
+        with pytest.raises(AssertionError, match="finality branch"):
+            step_args_from_finality_update(update, [], args.domain, TINY)
+
+    def test_rotation_converter_with_branch_extension(self):
+        from spectre_tpu.fields import bls12_381 as bls
+        from spectre_tpu.witness.types import bytes48_root
+        from spectre_tpu.gadgets.ssz_merkle import sha256_pair_native
+        from spectre_tpu.witness.rotation import mock_root
+        args = default_committee_update_args(TINY)
+        # craft an update whose branch is the container-depth branch: the
+        # converter must extend it with the aggregate-pubkey sibling
+        agg = bls.g1_compress(bls.sk_to_pk(999))
+        full_branch = [bytes48_root(agg)] + [bytes([d]) * 32
+                                             for d in range(TINY.sync_committee_depth)]
+        state_root = mock_root(args.committee_pubkeys_root(), full_branch,
+                               TINY.sync_committee_pubkeys_root_index)
+        hdr = dataclasses.replace(args.finalized_header, state_root=state_root)
+        update = {
+            "finalized_header": _hdr_dict(hdr),
+            "next_sync_committee": {
+                "pubkeys": ["0x" + pk.hex() for pk in args.pubkeys_compressed],
+                "aggregate_pubkey": "0x" + agg.hex(),
+            },
+            "next_sync_committee_branch": ["0x" + b.hex() for b in full_branch[1:]],
+        }
+        rebuilt = rotation_args_from_update(update, TINY)
+        assert len(rebuilt.sync_committee_branch) == TINY.sync_committee_pubkeys_depth
+
+
+class TestCalldata:
+    def test_roundtrip(self):
+        inst = [123, 456]
+        proof = b"\xAB" * 100
+        data = encode_calldata(inst, proof)
+        got_inst, got_proof = decode_calldata(data, 2)
+        assert (got_inst, got_proof) == (inst, proof)
+
+
+class TestFixtures:
+    def test_step_fixture_roundtrip(self, tmp_path):
+        args = default_sync_step_args(TINY)
+        p = str(tmp_path / "step.json")
+        dump_step_fixture(args, p)
+        back = load_step_fixture(p)
+        assert back == args
+
+    def test_rotation_fixture_roundtrip(self, tmp_path):
+        args = default_committee_update_args(TINY)
+        p = str(tmp_path / "rot.json")
+        dump_rotation_fixture(args, p)
+        assert load_rotation_fixture(p) == args
+
+
+class TestSpectreContract:
+    """Protocol tests with MockVerifiers (reference `contract-tests/tests/
+    spectre.rs:34-110` — multi-system testing without an EVM)."""
+
+    def _contract(self, period=0):
+        return SpectreContract(spec=TINY, initial_sync_period=period,
+                               initial_committee_poseidon=12345)
+
+    def test_step_advances_head(self):
+        args = default_sync_step_args(TINY)
+        c = self._contract(TINY.sync_period(args.attested_header.slot))
+        inp = StepInput(
+            attested_slot=args.attested_header.slot,
+            finalized_slot=args.finalized_header.slot,
+            participation=sum(args.participation_bits),
+            finalized_header_root=args.finalized_header.hash_tree_root(),
+            execution_payload_root=args.execution_payload_root)
+        c.step(inp, b"")
+        assert c.head == args.finalized_header.slot
+        assert c.block_header_roots[inp.finalized_slot] == inp.finalized_header_root
+
+    def test_step_input_encoding_matches_circuit(self):
+        # Solidity toPublicInputsCommitment == circuit get_instances[0]
+        # (reference `step_input_encoding.rs:109-116`)
+        args = default_sync_step_args(TINY)
+        inp = StepInput(
+            attested_slot=args.attested_header.slot,
+            finalized_slot=args.finalized_header.slot,
+            participation=sum(args.participation_bits),
+            finalized_header_root=args.finalized_header.hash_tree_root(),
+            execution_payload_root=args.execution_payload_root)
+        assert inp.to_public_inputs_commitment() == \
+            StepCircuit.get_instances(args, TINY)[0]
+
+    def test_step_rejects_low_participation(self):
+        c = self._contract(TINY.sync_period(10))
+        inp = StepInput(attested_slot=10, finalized_slot=9, participation=1,
+                        finalized_header_root=b"\x00" * 32,
+                        execution_payload_root=b"\x00" * 32)
+        with pytest.raises(AssertionError, match="participation"):
+            c.step(inp, b"")
+
+    def test_rotate_flow(self):
+        c = self._contract()
+        args = default_committee_update_args(TINY)
+        fin_slot = args.finalized_header.slot
+        root = args.finalized_header.hash_tree_root()
+        c.block_header_roots[fin_slot] = root
+        inst = CommitteeUpdateCircuit.get_instances(args, TINY)
+        c.rotate(fin_slot, inst[0], inst[1], inst[2], b"")
+        next_period = TINY.sync_period(fin_slot) + 1
+        assert c.sync_committee_poseidons[next_period] == inst[0]
+        # double rotation refused
+        with pytest.raises(AssertionError, match="already rotated"):
+            c.rotate(fin_slot, inst[0], inst[1], inst[2], b"")
+
+    def test_rotate_rejects_wrong_root(self):
+        c = self._contract()
+        c.block_header_roots[100] = b"\x01" * 32
+        with pytest.raises(AssertionError, match="header root mismatch"):
+            c.rotate(100, 1, 2, 3, b"")
+
+
+class _FakeState:
+    """Canned prover for RPC plumbing tests (real proving is minutes)."""
+
+    def __init__(self, spec):
+        self.spec = spec
+
+    def prove_step(self, args):
+        return b"\x01" * 64, StepCircuit.get_instances(args, self.spec)
+
+    def prove_committee(self, args):
+        return b"\x02" * 64, CommitteeUpdateCircuit.get_instances(args, self.spec)
+
+
+class TestRPC:
+    def test_rpc_roundtrip(self):
+        from spectre_tpu.fields import bls12_381 as bls
+        from spectre_tpu.prover_service.rpc import serve
+        state = _FakeState(TINY)
+        server = serve(state, port=0, background=True)
+        port = server.server_address[1]
+        try:
+            args = default_sync_step_args(TINY)
+            pks = [("0x" + bls.g1_compress((bls.Fq(x), bls.Fq(y))).hex())
+                   for x, y in args.pubkeys_uncompressed]
+            update = {
+                "attested_header": _hdr_dict(args.attested_header),
+                "finalized_header": _hdr_dict(args.finalized_header),
+                "finality_branch": ["0x" + b.hex() for b in args.finality_branch],
+                "execution_payload_root": "0x" + args.execution_payload_root.hex(),
+                "execution_branch": ["0x" + b.hex()
+                                     for b in args.execution_payload_branch],
+                "sync_aggregate": {
+                    "sync_committee_bits": args.participation_bits,
+                    "sync_committee_signature": "0x" + args.signature_compressed.hex(),
+                },
+            }
+            body = json.dumps({
+                "jsonrpc": "2.0", "id": 1,
+                "method": "genEvmProof_SyncStepCompressed",
+                "params": {"light_client_finality_update": update,
+                           "pubkeys": pks,
+                           "domain": "0x" + args.domain.hex()},
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/rpc", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                data = json.load(resp)
+            assert "result" in data, data
+            want = StepCircuit.get_instances(args, TINY)
+            assert [int(v, 16) for v in data["result"]["instances"]] == want
+            # unknown method -> JSON-RPC error
+            bad = json.dumps({"jsonrpc": "2.0", "id": 2, "method": "nope",
+                              "params": {}}).encode()
+            req2 = urllib.request.Request(
+                f"http://127.0.0.1:{port}/rpc", data=bad,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req2, timeout=60) as resp:
+                data2 = json.load(resp)
+            assert data2["error"]["code"] == -32601
+        finally:
+            server.shutdown()
+
+
+class TestCLI:
+    def test_parser(self):
+        from spectre_tpu.prover_service.cli import main
+        with pytest.raises(SystemExit) as e:
+            main(["--help"])
+        assert e.value.code == 0
+        with pytest.raises(SystemExit):
+            main(["circuit", "bogus", "setup"])
+
+
+class TestProfiling:
+    def test_phase_timers(self):
+        from spectre_tpu.utils import profiling as prof
+        prof.reset()
+        with prof.phase("unit/test"):
+            pass
+        t = prof.totals()
+        assert t["unit/test"]["count"] == 1
+        prof.reset()
+        assert prof.totals() == {}
