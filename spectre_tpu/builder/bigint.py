@@ -1,0 +1,159 @@
+"""CRT big-integer arithmetic over the gate/range chips.
+
+Reference parity: halo2-ecc's `ProperCrtUint` machinery (SURVEY.md L0/N5) —
+non-native field elements as NUM_LIMBS x LIMB_BITS limb cells plus a native
+(mod r) accumulator, with the classic CRT reduction: an identity is enforced
+mod r (one native inner product) AND over the limb radix (carry chain with
+signed range-checked carries), which together pin it over the integers.
+
+Redesigned, not ported: one universal vertical gate, range checks via the
+lookup table, carries witnessed with an offset to keep them unsigned.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..fields import bn254
+from ..spec import LIMB_BITS, NUM_LIMBS
+from .context import AssignedValue, Context
+from .gate import GateChip
+from .range_chip import RangeChip
+
+R = bn254.R
+BASE = 1 << LIMB_BITS
+
+
+@dataclass
+class CrtUint:
+    """limbs: NUM_LIMBS cells (< 2^LIMB_BITS each); native: value mod r;
+    value: the integer (witness bookkeeping)."""
+
+    limbs: list
+    native: AssignedValue
+    value: int
+
+
+class BigUintChip:
+    def __init__(self, rng: RangeChip):
+        self.rng = rng
+        self.gate = rng.gate
+        self._pow_native = [pow(BASE, i, R) for i in range(2 * NUM_LIMBS + 2)]
+
+    # -- construction ---------------------------------------------------
+    def load(self, ctx: Context, value: int, max_bits: int | None = None) -> CrtUint:
+        value = int(value)
+        assert value >= 0
+        max_bits = max_bits or NUM_LIMBS * LIMB_BITS
+        assert value < (1 << max_bits)
+        limbs = []
+        for i in range(NUM_LIMBS):
+            lv = (value >> (LIMB_BITS * i)) & (BASE - 1)
+            limb = ctx.load_witness(lv)
+            bits = min(LIMB_BITS, max(max_bits - LIMB_BITS * i, 0))
+            if bits == 0:
+                ctx.constrain_constant(limb, 0)
+            else:
+                self.rng.range_check(ctx, limb, bits)
+            limbs.append(limb)
+        native = self.gate.inner_product_const(ctx, limbs, self._pow_native[:NUM_LIMBS])
+        return CrtUint(limbs, native, value)
+
+    def load_constant(self, ctx: Context, value: int) -> CrtUint:
+        limbs = [ctx.load_constant((value >> (LIMB_BITS * i)) & (BASE - 1))
+                 for i in range(NUM_LIMBS)]
+        native = self.gate.inner_product_const(ctx, limbs, self._pow_native[:NUM_LIMBS])
+        return CrtUint(limbs, native, int(value))
+
+    # -- arithmetic (lazy: no reduction) --------------------------------
+    def add_no_carry(self, ctx: Context, a: CrtUint, b: CrtUint) -> CrtUint:
+        limbs = [self.gate.add(ctx, x, y) for x, y in zip(a.limbs, b.limbs)]
+        native = self.gate.add(ctx, a.native, b.native)
+        return CrtUint(limbs, native, a.value + b.value)
+
+    def mul_no_carry(self, ctx: Context, a: CrtUint, b: CrtUint) -> list:
+        """Limb convolution: returns 2*NUM_LIMBS-1 product-limb cells (each up
+        to ~2^(2*LIMB_BITS + log NUM_LIMBS) — still < r)."""
+        out = []
+        for k in range(2 * NUM_LIMBS - 1):
+            terms_a, terms_b = [], []
+            for i in range(max(0, k - NUM_LIMBS + 1), min(NUM_LIMBS, k + 1)):
+                terms_a.append(a.limbs[i])
+                terms_b.append(b.limbs[k - i])
+            out.append(self.gate.inner_product(ctx, terms_a, terms_b))
+        return out
+
+    # -- the CRT reduction ---------------------------------------------
+    def carry_mod(self, ctx: Context, prod_limbs: list, prod_value: int,
+                  p: int) -> CrtUint:
+        """Given overflowed limbs representing X (an integer < ~L*2^(2*104+3)),
+        witness q, r with X = q*p + r, 0 <= r < p; constrain the identity
+        (a) mod r via natives and (b) over the limb radix via a carry chain
+        with range-checked carries. Returns r as a CrtUint."""
+        gate = self.gate
+        q_val, r_val = divmod(prod_value, p)
+        q = self.load(ctx, q_val, max_bits=p.bit_length() + 8)
+        r = self.load(ctx, r_val, max_bits=p.bit_length())
+
+        # q*p limb convolution with CONSTANT p limbs
+        p_limbs = [(p >> (LIMB_BITS * i)) & (BASE - 1) for i in range(NUM_LIMBS)]
+        qp_limbs = []
+        for k in range(2 * NUM_LIMBS - 1):
+            terms, consts = [], []
+            for i in range(max(0, k - NUM_LIMBS + 1), min(NUM_LIMBS, k + 1)):
+                terms.append(q.limbs[i])
+                consts.append(p_limbs[k - i])
+            qp_limbs.append(gate.inner_product_const(ctx, terms, consts))
+
+        # (a) native identity: X - q*p - r == 0 (mod r)
+        x_native = gate.inner_product_const(
+            ctx, prod_limbs, self._pow_native[:len(prod_limbs)])
+        qp_native = gate.inner_product_const(
+            ctx, qp_limbs, self._pow_native[:len(qp_limbs)])
+        lhs = gate.sub(ctx, gate.sub(ctx, x_native, qp_native), r.native)
+        ctx.constrain_constant(lhs, 0)
+
+        # (b) limb-radix identity via carries:
+        #     t_k = X_k - (qp)_k - r_k ;  t_k + c_{k-1} = c_k * 2^LIMB_BITS
+        # carries are signed; witness c_k + OFFSET to range-check unsigned.
+        carry_bits = 2 * LIMB_BITS + NUM_LIMBS.bit_length() + 2 - LIMB_BITS
+        offset = 1 << (carry_bits + 1)
+        carry_prev = None
+        carry_prev_val = 0
+        nlimbs_tot = 2 * NUM_LIMBS - 1
+        t_vals = []
+        for k in range(nlimbs_tot):
+            xv = _val_of(prod_limbs[k])
+            qv = _val_of(qp_limbs[k])
+            rv = r.limbs[k].value if k < NUM_LIMBS else 0
+            t_vals.append(_signed(xv) - _signed(qv) - rv)
+        for k in range(nlimbs_tot):
+            t_cell = gate.sub(ctx, prod_limbs[k], qp_limbs[k])
+            if k < NUM_LIMBS:
+                t_cell = gate.sub(ctx, t_cell, r.limbs[k])
+            if carry_prev is not None:
+                t_cell = gate.add(ctx, t_cell, carry_prev)
+            total = t_vals[k] + carry_prev_val
+            assert total % BASE == 0, "carry chain misaligned"
+            c_val = total // BASE
+            assert abs(c_val) < offset
+            c = ctx.load_witness((c_val + offset) % R)
+            self.rng.range_check(ctx, c, carry_bits + 2)
+            # t_cell == (c - offset) * BASE  <=>  t_cell + offset*BASE == c*BASE
+            shifted = gate.add(ctx, t_cell, (offset * BASE) % R)
+            recomb = gate.mul(ctx, c, BASE)
+            ctx.constrain_equal(shifted, recomb)
+            carry_prev = gate.sub(ctx, c, offset)
+            carry_prev_val = c_val
+        # final carry must be zero
+        ctx.constrain_constant(carry_prev, 0)
+        return r
+
+
+def _val_of(cell) -> int:
+    return cell.value
+
+
+def _signed(v: int) -> int:
+    """Interpret a mod-r value produced by gate.sub as a (small) signed int."""
+    return v if v < R // 2 else v - R

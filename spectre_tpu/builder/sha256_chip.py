@@ -47,10 +47,19 @@ class Sha256Chip:
     # -- nibble plumbing ------------------------------------------------
     def _push_op(self, ctx: Context, op: int, x: AssignedValue, y: AssignedValue,
                  z_val: int) -> AssignedValue:
-        """Witness z and prove (op, x, y, z) is a table row. Table membership
-        also proves x, y, z are valid nibbles."""
+        """Witness z and prove (op, x, y, z) is a table row.
+
+        SOUNDNESS INVARIANT: x and y must ALREADY be range-checked nibbles by
+        the caller (decompositions check theirs; chained op outputs are checked
+        here). z is range-checked before packing — without it (or with the old
+        257*x "self-XOR" trick) the packed fields alias across bit boundaries
+        and a malicious prover can forge bitwise results (found by review:
+        packed 17 = 0x011 decodes as the valid XOR row 0^1=1)."""
+        assert x.value < 16 and y.value < 16, "unchecked nibble into _push_op"
         z = ctx.load_witness(z_val)
-        # packed = op*4096 + x*256 + y*16 + z
+        self._check_nibble(ctx, z)
+        # packed = op*4096 + x*256 + y*16 + z — uniquely decodable since all
+        # three fields are independently constrained to [0, 16)
         t1 = self.gate.mul_add(ctx, y, 16, z)
         packed = self.gate.mul_add(ctx, x, 256, t1)
         if op:
@@ -59,9 +68,8 @@ class Sha256Chip:
         return z
 
     def _check_nibble(self, ctx: Context, x: AssignedValue):
-        """x in [0,16) via the XOR table row (op=0, x, 0, x): packed = 257x."""
-        packed = self.gate.mul(ctx, x, 257)
-        ctx.push_lookup_table(packed, "nibble_op")
+        """x in [0,16) via membership in the dedicated 16-row nibble table."""
+        ctx.push_lookup_table(x, "nibble")
 
     def _decompose(self, ctx: Context, cell: AssignedValue) -> list:
         """cell (32-bit value) -> 8 checked nibbles, recomposition constrained."""

@@ -12,9 +12,6 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from ..models.step import StepCircuit
-from ..prover_service.calldata import decode_calldata
-
 
 class MockVerifier:
     """Accepts everything (reference `MockVerifier.sol` — protocol tests
@@ -82,7 +79,7 @@ class SpectreContract:
     def step(self, inp: StepInput, proof: bytes):
         period = self.spec.sync_period(inp.attested_slot)
         poseidon = self.sync_committee_poseidons.get(period)
-        assert poseidon, f"no committee for period {period}"
+        assert poseidon is not None, f"no committee for period {period}"
         commitment = inp.to_public_inputs_commitment()
         assert self.step_verifier.verify([commitment, poseidon], proof), \
             "step proof invalid"

@@ -95,6 +95,17 @@ def verify_merkle_proof(ctx: Context, sha: Sha256Chip, leaf: list, branch: list,
         ctx.constrain_equal(a.cell, b.cell)
 
 
+def load_bytes_checked(ctx: Context, sha: Sha256Chip, data: bytes) -> list:
+    """Witness a byte string as 8-bit-checked cells (the shared loader both
+    app circuits use for roots/branches/pubkeys)."""
+    out = []
+    for bt in data:
+        c = ctx.load_witness(bt)
+        sha._range_bits(ctx, c, 8)
+        out.append(c)
+    return out
+
+
 def bytes_to_chunk(ctx: Context, sha: Sha256Chip, byte_cells: list) -> list:
     """32 byte cells (8-bit checked) -> 8-Word chunk (big-endian words)."""
     assert len(byte_cells) == 32

@@ -38,12 +38,7 @@ class CommitteeUpdateCircuit(AppCircuit):
         pubkey_bytes = []
         for pk in args.pubkeys_compressed:
             assert len(pk) == 48
-            cells = []
-            for bt in pk:
-                c = ctx.load_witness(bt)
-                sha._range_bits(ctx, c, 8)
-                cells.append(c)
-            pubkey_bytes.append(cells)
+            pubkey_bytes.append(M.load_bytes_checked(ctx, sha, pk))
 
         # --- committee pubkeys SSZ root (leaf = sha256(pk padded to 64)) ---
         zero = ctx.load_constant(0)
@@ -76,20 +71,11 @@ class CommitteeUpdateCircuit(AppCircuit):
 
         # --- finalized header SSZ root ---
         def uint64_chunk_cells(v: int):
-            cells = []
-            for i in range(8):
-                c = ctx.load_witness((int(v) >> (8 * i)) & 0xFF)
-                sha._range_bits(ctx, c, 8)
-                cells.append(c)
+            cells = M.load_bytes_checked(ctx, sha, int(v).to_bytes(8, "little"))
             return cells + [zero] * 24
 
         def root_chunk_cells(b: bytes):
-            cells = []
-            for bt in b:
-                c = ctx.load_witness(bt)
-                sha._range_bits(ctx, c, 8)
-                cells.append(c)
-            return cells
+            return M.load_bytes_checked(ctx, sha, b)
 
         hdr = args.finalized_header
         state_root_cells = root_chunk_cells(hdr.state_root)

@@ -87,16 +87,10 @@ class StepCircuit(AppCircuit):
         zero = ctx.load_constant(0)
 
         def byte_cells_checked(bs: bytes):
-            out = []
-            for bt in bs:
-                c = ctx.load_witness(bt)
-                sha._range_bits(ctx, c, 8)
-                out.append(c)
-            return out
+            return M.load_bytes_checked(ctx, sha, bs)
 
         def uint64_cells(v: int):
-            out = byte_cells_checked(int(v).to_bytes(8, "little"))
-            return out
+            return byte_cells_checked(int(v).to_bytes(8, "little"))
 
         def header_chunks(hdr):
             slot_cells = uint64_cells(hdr.slot)
@@ -135,12 +129,8 @@ class StepCircuit(AppCircuit):
                               spec.execution_state_root_index, fin_body_chunk)
 
         # --- public input commitment ---
-        sum_cells = []
-        sv = participation_sum.value
-        for i in range(8):
-            c = ctx.load_witness((sv >> (8 * i)) & 0xFF)
-            sha._range_bits(ctx, c, 8)
-            sum_cells.append(c)
+        sum_cells = M.load_bytes_checked(
+            ctx, sha, int(participation_sum.value).to_bytes(8, "little"))
         acc = gate.inner_product_const(ctx, sum_cells, [1 << (8 * i) for i in range(8)])
         ctx.constrain_equal(acc, participation_sum)
 

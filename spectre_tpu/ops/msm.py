@@ -162,6 +162,8 @@ def combine_windows(window_sums, c: int):
 
 
 def default_window(n: int) -> int:
+    # c > 13 OOMs in _aggregate_buckets (the bit-decomposition select
+    # materializes [nwin, c, 2^c, 3, 16]); 13 is the practical ceiling.
     if n >= 1 << 18:
         return 13
     if n >= 1 << 12:
@@ -178,3 +180,25 @@ def msm(points, scalars, c: int | None = None):
     if c is None:
         c = default_window(n)
     return combine_windows(msm_windows(points, scalars, c), c)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def msm_windows_batch(points, scalars_batch, c: int):
+    """Batched MSM window phase: one point set, many scalar vectors.
+
+    scalars_batch: [m, n, 16] -> [m, nwin, 3, 16]. The inter-proof /
+    multi-column batching axis (SURVEY.md §2c(b)). MEASURED NOTE: on a single
+    chip this is bandwidth-bound and vmap multiplies HBM traffic — batch=8 at
+    2^16 ran ~3x slower than sequential single MSMs, so the sequential path
+    stays the default; this entry point exists for multi-chip sharding where
+    the batch axis maps onto the mesh."""
+    return jax.vmap(lambda sc: msm_windows.__wrapped__(points, sc, c))(scalars_batch)
+
+
+def msm_batch(points, scalars_batch, c: int | None = None):
+    """[m] results (projective [m, 3, 16]) for m scalar vectors."""
+    n = points.shape[0]
+    if c is None:
+        c = default_window(n)
+    wins = msm_windows_batch(points, scalars_batch, c)
+    return jax.vmap(lambda w: combine_windows.__wrapped__(w, c))(wins)

@@ -72,6 +72,10 @@ class CpuBackend:
         m = min(points.shape[0], scalars.shape[0])
         return host.g1_msm(points[:m], scalars[:m])
 
+    def msm_many(self, points, scalars_list):
+        """Commit several scalar vectors against the same base points."""
+        return [self.msm(points, sc) for sc in scalars_list]
+
 
 class TpuBackend(CpuBackend):
     """JAX backend: MSM/NTT ride the device kernels; small ops stay native.

@@ -129,6 +129,8 @@ def table_column(cfg: CircuitConfig, table_id: str = "range") -> list:
                  custom gates: pure lookups, no custom region)."""
     if table_id == "range":
         vals = list(range(1 << cfg.lookup_bits))
+    elif table_id == "nibble":
+        vals = list(range(16))
     elif table_id == "nibble_op":
         vals = []
         for x in range(16):

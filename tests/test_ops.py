@@ -200,3 +200,18 @@ class TestPoseidon:
         # MDS must be invertible (Cauchy construction): det != 0 via rank over Fr
         # cheap sanity: no duplicate rows
         assert len({tuple(r) for r in mds}) == POS.T
+
+
+class TestMSMBatch:
+    def test_matches_single(self):
+        n, m = 32, 3
+        g = bn.G1_GEN
+        pts = [bn.g1_curve.mul(g, k + 1) for k in range(n)]
+        pp = ec.encode_points(pts)
+        scs = [[(i * 131 + k * 7 + 1) % bn.R for k in range(n)] for i in range(m)]
+        batch = jnp.stack([jnp.asarray(L.ints_to_limbs16(sc)) for sc in scs])
+        res = MSM.msm_batch(pp, batch, c=4)
+        got = ec.decode_points(res)
+        for sc, g_pt in zip(scs, got):
+            want = bn.g1_curve.msm(pts, sc)
+            assert g_pt == (int(want[0]), int(want[1]))
