@@ -17,6 +17,17 @@ from ..native import host
 R = bn254.R
 
 
+def setup_compile_cache():
+    """Per-platform persistent JAX compile cache (shared policy for bench,
+    backends, and entry points; axon-remote AOT entries are not loadable by
+    the CPU backend, hence per-backend dirs)."""
+    import jax
+    if not jax.config.jax_compilation_cache_dir:
+        jax.config.update("jax_compilation_cache_dir",
+                          f"/tmp/jax_cache_{jax.default_backend()}")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
 def to_arr(vals) -> np.ndarray:
     return host.ints_to_limbs([int(v) % R for v in vals])
 
@@ -86,8 +97,10 @@ class TpuBackend(CpuBackend):
     name = "tpu"
 
     def __init__(self):
-        import jax  # noqa: F401  (fail fast if jax unusable)
+        import jax  # noqa: F401  fail fast if jax unusable
         from ..ops import limbs as L16  # noqa: F401
+        # per-shape compiles dominate small-circuit wall-clock; persist them
+        setup_compile_cache()
 
     def msm(self, points, scalars):
         import jax.numpy as jnp

@@ -1,5 +1,6 @@
 """Proving-system tests: KZG/SHPLONK, transcripts, full prove/verify."""
 
+import os
 import secrets
 
 import numpy as np
@@ -260,3 +261,21 @@ class TestMockProver:
         asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
         with pytest.raises(AssertionError, match="not in table"):
             mock_prove(cfg, asg)
+
+
+@pytest.mark.skipif(not os.environ.get("RUN_SLOW"),
+                    reason="minutes of device-kernel compile")
+class TestTpuBackendPath:
+    def test_prove_via_device_kernels(self, srs):
+        """The --backend tpu wiring: MSM/NTT through the JAX limb kernels
+        (runs on whatever JAX backend is active — CPU in CI)."""
+        cfg = CircuitConfig(k=K, num_advice=1, num_lookup_advice=1, num_fixed=1,
+                            lookup_bits=4)
+        advice, lookup, fixed, selectors, copies, out = _tiny_circuit(cfg)
+        bk = B.get_backend("tpu")
+        pk = keygen(srs, cfg, fixed, selectors, copies, bk)
+        pk_cpu = keygen(srs, cfg, fixed, selectors, copies, B.get_backend("cpu"))
+        assert pk.vk.digest() == pk_cpu.vk.digest()
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
+        proof = prove(pk, srs, asg, bk)
+        assert verify(pk.vk, srs, [[out]], proof)
