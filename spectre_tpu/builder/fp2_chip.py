@@ -1,0 +1,122 @@
+"""Fp2 chip: BLS12-381 quadratic-extension arithmetic over FpChip.
+
+Reference parity: halo2-ecc `Fp2Chip` (SURVEY.md L0) — the coordinate field of
+G2 points (signatures live in G2), and with it the G2 EccChip. This is the
+round-2 pairing path's next layer; landed in round 1 so the StepCircuit's
+signature block can assemble on top of tested primitives.
+
+Elements are (c0, c1) CrtUint pairs representing c0 + c1*u with u^2 = -1.
+"""
+
+from __future__ import annotations
+
+from ..fields import bls12_381 as bls
+from .context import Context
+from .fp_chip import FpChip
+
+P = bls.P
+
+
+class Fp2Chip:
+    def __init__(self, fp: FpChip):
+        self.fp = fp
+
+    def load(self, ctx: Context, v) -> tuple:
+        """v: fields.bls12_381.Fq2 or (c0, c1) ints."""
+        c0, c1 = (v.c if hasattr(v, "c") else v)
+        return (self.fp.load(ctx, int(c0)), self.fp.load(ctx, int(c1)))
+
+    def load_constant(self, ctx: Context, v) -> tuple:
+        c0, c1 = (v.c if hasattr(v, "c") else v)
+        return (self.fp.load_constant(ctx, int(c0)),
+                self.fp.load_constant(ctx, int(c1)))
+
+    def value(self, a) -> "bls.Fq2":
+        return bls.Fq2([a[0].value % P, a[1].value % P])
+
+    def add(self, ctx: Context, a, b) -> tuple:
+        return (self.fp.add(ctx, a[0], b[0]), self.fp.add(ctx, a[1], b[1]))
+
+    def sub(self, ctx: Context, a, b) -> tuple:
+        return (self.fp.sub(ctx, a[0], b[0]), self.fp.sub(ctx, a[1], b[1]))
+
+    def mul(self, ctx: Context, a, b) -> tuple:
+        """(a0 + a1 u)(b0 + b1 u) = (a0b0 - a1b1) + (a0b1 + a1b0) u."""
+        a0b0 = self.fp.mul(ctx, a[0], b[0])
+        a1b1 = self.fp.mul(ctx, a[1], b[1])
+        a0b1 = self.fp.mul(ctx, a[0], b[1])
+        a1b0 = self.fp.mul(ctx, a[1], b[0])
+        return (self.fp.sub(ctx, a0b0, a1b1), self.fp.add(ctx, a0b1, a1b0))
+
+    def square(self, ctx: Context, a) -> tuple:
+        """(a0^2 - a1^2) + 2 a0 a1 u (complex squaring)."""
+        s = self.fp.add(ctx, a[0], a[1])
+        d = self.fp.sub(ctx, a[0], a[1])
+        c0 = self.fp.mul(ctx, s, d)
+        a0a1 = self.fp.mul(ctx, a[0], a[1])
+        return (c0, self.fp.mul_scalar(ctx, a0a1, 2))
+
+    def mul_scalar(self, ctx: Context, a, k: int) -> tuple:
+        return (self.fp.mul_scalar(ctx, a[0], k), self.fp.mul_scalar(ctx, a[1], k))
+
+    def neg(self, ctx: Context, a) -> tuple:
+        zero = self.fp.load_constant(ctx, 0)
+        return (self.fp.sub(ctx, zero, a[0]), self.fp.sub(ctx, zero, a[1]))
+
+    def conjugate(self, ctx: Context, a) -> tuple:
+        zero = self.fp.load_constant(ctx, 0)
+        return (a[0], self.fp.sub(ctx, zero, a[1]))
+
+    def div_unsafe(self, ctx: Context, a, b) -> tuple:
+        """q with q*b == a; witness the quotient, constrain the product."""
+        av, bv = self.value(a), self.value(b)
+        qv = av / bv
+        q = self.load(ctx, qv)
+        prod = self.mul(ctx, q, b)
+        self.assert_equal(ctx, prod, a)
+        return q
+
+    def assert_equal(self, ctx: Context, a, b):
+        self.fp.assert_equal(ctx, self.fp._reduced(ctx, a[0]),
+                             self.fp._reduced(ctx, b[0]))
+        self.fp.assert_equal(ctx, self.fp._reduced(ctx, a[1]),
+                             self.fp._reduced(ctx, b[1]))
+
+
+class G2Chip:
+    """Non-native G2 affine arithmetic over Fp2Chip (reference: halo2-ecc
+    `EccChip<Fp2>` — the signature-side group of `assign_signature:279`)."""
+
+    def __init__(self, fp2: Fp2Chip):
+        self.fp2 = fp2
+
+    def load_point(self, ctx: Context, pt) -> tuple:
+        """On-curve check y^2 == x^3 + 4(1+u)."""
+        x = self.fp2.load(ctx, pt[0])
+        y = self.fp2.load(ctx, pt[1])
+        y2 = self.fp2.square(ctx, y)
+        x3 = self.fp2.mul(ctx, self.fp2.square(ctx, x), x)
+        b2 = self.fp2.load_constant(ctx, bls.B2)
+        rhs = self.fp2.add(ctx, x3, b2)
+        self.fp2.assert_equal(ctx, y2, rhs)
+        return (x, y)
+
+    def add_unequal(self, ctx: Context, p, q) -> tuple:
+        x1, y1 = p
+        x2, y2 = q
+        lam = self.fp2.div_unsafe(ctx, self.fp2.sub(ctx, y2, y1),
+                                  self.fp2.sub(ctx, x2, x1))
+        lam2 = self.fp2.square(ctx, lam)
+        x3 = self.fp2.sub(ctx, self.fp2.sub(ctx, lam2, x1), x2)
+        y3 = self.fp2.sub(ctx, self.fp2.mul(ctx, lam, self.fp2.sub(ctx, x1, x3)), y1)
+        return (x3, y3)
+
+    def double(self, ctx: Context, p) -> tuple:
+        x1, y1 = p
+        three_x2 = self.fp2.mul_scalar(ctx, self.fp2.square(ctx, x1), 3)
+        two_y = self.fp2.mul_scalar(ctx, y1, 2)
+        lam = self.fp2.div_unsafe(ctx, three_x2, two_y)
+        lam2 = self.fp2.square(ctx, lam)
+        x3 = self.fp2.sub(ctx, self.fp2.sub(ctx, lam2, x1), x1)
+        y3 = self.fp2.sub(ctx, self.fp2.mul(ctx, lam, self.fp2.sub(ctx, x1, x3)), y1)
+        return (x3, y3)

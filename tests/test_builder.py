@@ -251,3 +251,48 @@ class TestShaSoundnessRegressions:
         from spectre_tpu.plonk.mock import mock_prove
         cfg = ctx.auto_config(k=13, lookup_bits=8)
         assert mock_prove(cfg, ctx.assignment(cfg))
+
+
+class TestFp2G2Chips:
+    """Quadratic extension + G2 ops (the signature-side group)."""
+
+    def test_fp2_arithmetic(self):
+        from spectre_tpu.builder.fp_chip import FpChip
+        from spectre_tpu.builder.fp2_chip import Fp2Chip
+        from spectre_tpu.fields import bls12_381 as bls
+        ctx = Context()
+        fp2 = Fp2Chip(FpChip(RangeChip(lookup_bits=8)))
+        a_v, b_v = bls.Fq2([3, 7]), bls.Fq2([11, 13])
+        a, b = fp2.load(ctx, a_v), fp2.load(ctx, b_v)
+        assert fp2.value(fp2.mul(ctx, a, b)) == a_v * b_v
+        assert fp2.value(fp2.square(ctx, a)) == a_v * a_v
+        assert fp2.value(fp2.div_unsafe(ctx, a, b)) == a_v / b_v
+        assert fp2.value(fp2.conjugate(ctx, a)) == bls.Fq2([3, (-7) % bls.P])
+        _mock(ctx, k=13)
+
+    def test_g2_group_law(self):
+        from spectre_tpu.builder.fp_chip import FpChip
+        from spectre_tpu.builder.fp2_chip import Fp2Chip, G2Chip
+        from spectre_tpu.fields import bls12_381 as bls
+        ctx = Context()
+        fp2 = Fp2Chip(FpChip(RangeChip(lookup_bits=8)))
+        g2 = G2Chip(fp2)
+        p1 = bls.g2_curve.mul(bls.G2_GEN, 5)
+        p2 = bls.g2_curve.mul(bls.G2_GEN, 9)
+        c1, c2 = g2.load_point(ctx, p1), g2.load_point(ctx, p2)
+        s = g2.add_unequal(ctx, c1, c2)
+        want = bls.g2_curve.add(p1, p2)
+        assert (fp2.value(s[0]), fp2.value(s[1])) == (want[0], want[1])
+        d = g2.double(ctx, c1)
+        wantd = bls.g2_curve.double(p1)
+        assert (fp2.value(d[0]), fp2.value(d[1])) == (wantd[0], wantd[1])
+        _mock(ctx, k=14)
+
+    def test_g2_off_curve_rejected(self):
+        from spectre_tpu.builder.fp_chip import FpChip
+        from spectre_tpu.builder.fp2_chip import Fp2Chip, G2Chip
+        from spectre_tpu.fields import bls12_381 as bls
+        ctx = Context()
+        g2 = G2Chip(Fp2Chip(FpChip(RangeChip(lookup_bits=8))))
+        with pytest.raises(AssertionError):
+            g2.load_point(ctx, (bls.Fq2([1, 2]), bls.Fq2([3, 4])))
