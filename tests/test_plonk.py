@@ -279,3 +279,19 @@ class TestTpuBackendPath:
         asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
         proof = prove(pk, srs, asg, bk)
         assert verify(pk.vk, srs, [[out]], proof)
+
+
+class TestKeccakTranscriptPath:
+    """The EVM-oriented transcript (Keccak-256) through full prove/verify —
+    the reference's gen_evm_proof path uses exactly this hash for challenges."""
+
+    def test_prove_verify_keccak(self, srs):
+        cfg = CircuitConfig(k=K, num_advice=1, num_lookup_advice=1, num_fixed=1,
+                            lookup_bits=4)
+        advice, lookup, fixed, selectors, copies, out = _tiny_circuit(cfg)
+        pk = keygen(srs, cfg, fixed, selectors, copies)
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
+        proof = prove(pk, srs, asg, transcript=KeccakTranscript())
+        assert verify(pk.vk, srs, [[out]], proof, transcript_cls=KeccakTranscript)
+        # a keccak proof must NOT verify under the blake2b transcript
+        assert not verify(pk.vk, srs, [[out]], proof)
