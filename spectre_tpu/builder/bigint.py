@@ -116,21 +116,34 @@ class BigUintChip:
         # (b) limb-radix identity via carries:
         #     t_k = X_k - (qp)_k - r_k ;  t_k + c_{k-1} = c_k * 2^LIMB_BITS
         # carries are signed; witness c_k + OFFSET to range-check unsigned.
-        carry_bits = 2 * LIMB_BITS + NUM_LIMBS.bit_length() + 2 - LIMB_BITS
-        offset = 1 << (carry_bits + 1)
-        carry_prev = None
-        carry_prev_val = 0
         nlimbs_tot = 2 * NUM_LIMBS - 1
-        t_vals = []
+        t_cells, t_vals = [], []
         for k in range(nlimbs_tot):
             xv = _val_of(prod_limbs[k])
             qv = _val_of(qp_limbs[k])
             rv = r.limbs[k].value if k < NUM_LIMBS else 0
             t_vals.append(_signed(xv) - _signed(qv) - rv)
-        for k in range(nlimbs_tot):
             t_cell = gate.sub(ctx, prod_limbs[k], qp_limbs[k])
             if k < NUM_LIMBS:
                 t_cell = gate.sub(ctx, t_cell, r.limbs[k])
+            t_cells.append(t_cell)
+        self._carry_chain_zero(ctx, t_cells, t_vals)
+        return r
+
+    def _carry_chain_zero(self, ctx: Context, t_cells: list, t_vals: list,
+                          carry_bits: int | None = None):
+        """Constrain sum_k t_k * BASE^k == 0 over the integers, given limb
+        cells t_k with |t_k| < ~2^(LIMB_BITS + carry_bits). Carries are signed;
+        each is witnessed with an offset so a single unsigned range check
+        bounds it."""
+        gate = self.gate
+        if carry_bits is None:
+            carry_bits = 2 * LIMB_BITS + NUM_LIMBS.bit_length() + 2 - LIMB_BITS
+        offset = 1 << (carry_bits + 1)
+        carry_prev = None
+        carry_prev_val = 0
+        for k in range(len(t_cells)):
+            t_cell = t_cells[k]
             if carry_prev is not None:
                 t_cell = gate.add(ctx, t_cell, carry_prev)
             total = t_vals[k] + carry_prev_val
@@ -147,7 +160,55 @@ class BigUintChip:
             carry_prev_val = c_val
         # final carry must be zero
         ctx.constrain_constant(carry_prev, 0)
-        return r
+
+    def check_carry_to_zero(self, ctx: Context, prod_limbs: list,
+                            prod_value: int, p: int):
+        """Constrain X == 0 (mod p) for overflowed limbs X: witness q with
+        X = q*p exactly, constrain natively and over the limb radix. The
+        mod-p analog of halo2-ecc `check_carry_mod_to_zero`."""
+        gate = self.gate
+        assert prod_value % p == 0, "check_carry_to_zero: value not divisible"
+        q_val = prod_value // p
+        # same static shape as carry_mod's quotient (shape must not depend on
+        # the witness): products of reduced operands give q < ~L * 2^(2*104) / p
+        q = self.load(ctx, q_val, max_bits=p.bit_length() + 8)
+        p_limbs = [(p >> (LIMB_BITS * i)) & (BASE - 1) for i in range(NUM_LIMBS)]
+        qp_limbs = []
+        for k in range(2 * NUM_LIMBS - 1):
+            terms, consts = [], []
+            for i in range(max(0, k - NUM_LIMBS + 1), min(NUM_LIMBS, k + 1)):
+                terms.append(q.limbs[i])
+                consts.append(p_limbs[k - i])
+            qp_limbs.append(gate.inner_product_const(ctx, terms, consts))
+        x_native = gate.inner_product_const(
+            ctx, prod_limbs, self._pow_native[:len(prod_limbs)])
+        qp_native = gate.inner_product_const(
+            ctx, qp_limbs, self._pow_native[:len(qp_limbs)])
+        ctx.constrain_constant(gate.sub(ctx, x_native, qp_native), 0)
+        t_cells, t_vals = [], []
+        for k in range(2 * NUM_LIMBS - 1):
+            t_vals.append(_signed(_val_of(prod_limbs[k])) -
+                          _signed(_val_of(qp_limbs[k])))
+            t_cells.append(gate.sub(ctx, prod_limbs[k], qp_limbs[k]))
+        self._carry_chain_zero(ctx, t_cells, t_vals)
+
+    def enforce_lt(self, ctx: Context, a: CrtUint, bound: int):
+        """Constrain a < bound (a compile-time constant) exactly, not just by
+        limb width: witness d = bound-1-a, range-check d's limbs, and tie
+        a + d == bound-1 over the limb radix. halo2-ecc ProperCrtUint's
+        canonicality check (`ADVICE.md` bigint.py finding)."""
+        gate = self.gate
+        m = bound - 1
+        assert 0 <= a.value <= m, "enforce_lt: witness out of range"
+        d = self.load(ctx, m - a.value, max_bits=bound.bit_length())
+        m_limbs = [(m >> (LIMB_BITS * i)) & (BASE - 1) for i in range(NUM_LIMBS)]
+        t_cells, t_vals = [], []
+        for k in range(NUM_LIMBS):
+            t = gate.add(ctx, a.limbs[k], d.limbs[k])
+            t_cells.append(gate.sub(ctx, t, m_limbs[k]))
+            t_vals.append(a.limbs[k].value + d.limbs[k].value - m_limbs[k])
+        # sums of two limbs minus a limb: carries fit in 2 bits
+        self._carry_chain_zero(ctx, t_cells, t_vals, carry_bits=2)
 
 
 def _val_of(cell) -> int:

@@ -82,6 +82,16 @@ class Fp2Chip:
         self.fp.assert_equal(ctx, self.fp._reduced(ctx, a[1]),
                              self.fp._reduced(ctx, b[1]))
 
+    def assert_nonzero(self, ctx: Context, a):
+        """Constrain a != 0 in Fp2 via witnessed inverse a*inv == 1 (same
+        soundness argument as FpChip.assert_nonzero)."""
+        av = self.value(a)
+        assert av != bls.Fq2([0, 0]), "assert_nonzero: witness is zero"
+        inv = self.load(ctx, bls.Fq2([1, 0]) / av)
+        prod = self.mul(ctx, a, inv)
+        one = self.load_constant(ctx, (1, 0))
+        self.assert_equal(ctx, prod, one)
+
 
 class G2Chip:
     """Non-native G2 affine arithmetic over Fp2Chip (reference: halo2-ecc
@@ -101,11 +111,14 @@ class G2Chip:
         self.fp2.assert_equal(ctx, y2, rhs)
         return (x, y)
 
-    def add_unequal(self, ctx: Context, p, q) -> tuple:
+    def add_unequal(self, ctx: Context, p, q, strict: bool = True) -> tuple:
+        """Chord addition; strict constrains x1 != x2 (see EccChip.add_unequal)."""
         x1, y1 = p
         x2, y2 = q
-        lam = self.fp2.div_unsafe(ctx, self.fp2.sub(ctx, y2, y1),
-                                  self.fp2.sub(ctx, x2, x1))
+        dx = self.fp2.sub(ctx, x2, x1)
+        if strict:
+            self.fp2.assert_nonzero(ctx, dx)
+        lam = self.fp2.div_unsafe(ctx, self.fp2.sub(ctx, y2, y1), dx)
         lam2 = self.fp2.square(ctx, lam)
         x3 = self.fp2.sub(ctx, self.fp2.sub(ctx, lam2, x1), x2)
         y3 = self.fp2.sub(ctx, self.fp2.mul(ctx, lam, self.fp2.sub(ctx, x1, x3)), y1)

@@ -81,6 +81,30 @@ class FpChip:
         padded = a.limbs + [zero] * (2 * len(a.limbs) - 1 - len(a.limbs))
         return self.big.carry_mod(ctx, padded, a.value, P)
 
+    def assert_nonzero(self, ctx: Context, a: CrtUint):
+        """Constrain a != 0 (mod p) via a witnessed inverse: a*inv - 1 == 0
+        (mod p). Sound without canonical form — no inverse of 0 exists, so no
+        witness satisfies the relation when a = 0 mod p. Closes the P == Q
+        forgery hole in witness-slope addition (`ADVICE.md` fp_chip finding;
+        reference: halo2-ecc strict `ec_add_unequal`)."""
+        av = a.value % P
+        assert av != 0, "assert_nonzero: witness is zero"
+        inv = self.load(ctx, pow(av, -1, P))
+        prod = self.big.mul_no_carry(ctx, a, inv)
+        # subtract 1 from the low product limb, then carry the lot to zero
+        from ..fields import bn254
+        prod0 = self.gate.add(ctx, prod[0], bn254.R - 1)
+        self.big.check_carry_to_zero(ctx, [prod0] + prod[1:],
+                                     a.value * inv.value - 1, P)
+
+    def canonicalize(self, ctx: Context, a: CrtUint) -> CrtUint:
+        """Reduce and enforce the canonical representative r < p (not just
+        r < 2^381). Use at circuit boundaries where limbs become public or
+        byte-compared (`ADVICE.md` bigint.py finding)."""
+        r = self._reduced(ctx, a)
+        self.big.enforce_lt(ctx, r, P)
+        return r
+
 
 class EccChip:
     """Non-native G1 affine arithmetic (BLS12-381) over FpChip.
@@ -105,11 +129,18 @@ class EccChip:
         self.fp.assert_equal(ctx, y2, rhs)
         return (xc, yc)
 
-    def add_unequal(self, ctx: Context, p, q) -> tuple:
-        """(x1,y1)+(x2,y2), x1 != x2: witness slope; standard chord formulas."""
+    def add_unequal(self, ctx: Context, p, q, strict: bool = True) -> tuple:
+        """(x1,y1)+(x2,y2), x1 != x2: witness slope; standard chord formulas.
+
+        strict constrains dx != 0 — without it, P == Q makes both div_unsafe
+        operands 0 and ANY slope satisfies q*0 = 0, letting a prover forge the
+        sum (halo2-ecc strict mode; `ADVICE.md`). Pass strict=False only when
+        x1 != x2 is already constrained elsewhere."""
         x1, y1 = p
         x2, y2 = q
         dx = self.fp.sub(ctx, x2, x1)
+        if strict:
+            self.fp.assert_nonzero(ctx, dx)
         dy = self.fp.sub(ctx, y2, y1)
         lam = self.fp.div_unsafe(ctx, dy, dx)
         lam2 = self.fp.mul(ctx, lam, lam)

@@ -15,9 +15,15 @@ import numpy as np
 from jax.sharding import Mesh
 
 
-def make_mesh(n_devices: int | None = None, data_axis: int | None = None) -> Mesh:
-    devs = jax.devices()
+def make_mesh(n_devices: int | None = None, data_axis: int | None = None,
+              devices: list | None = None, strict: bool = False) -> Mesh:
+    devs = devices if devices is not None else jax.devices()
     if n_devices is not None:
+        if strict and len(devs) < n_devices:
+            raise RuntimeError(
+                f"make_mesh: {n_devices} devices requested but only "
+                f"{len(devs)} available — refusing to validate a collapsed "
+                f"mesh (round-1 failure mode: silently truncating to 1x1)")
         devs = devs[:n_devices]
     n = len(devs)
     if data_axis is None:

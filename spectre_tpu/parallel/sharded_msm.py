@@ -70,7 +70,10 @@ def sharded_msm(points, scalars, c: int, mesh: Mesh):
         wins = jax.lax.all_gather(folded, "win")
         return wins.reshape(nwin_padded, 3, ec.F.NLIMBS)
 
-    window_sums = windows_phase(points, scalars)[:nwin]
+    # jit the SPMD program: eager shard_map calls bypass the persistent
+    # compile cache, which made every dryrun/bench pay the full multi-minute
+    # XLA CPU compile (round-1 MULTICHIP timeout)
+    window_sums = jax.jit(windows_phase)(points, scalars)[:nwin]
     return MSM.combine_windows(window_sums, c)
 
 

@@ -83,6 +83,12 @@ def all_expressions(cfg: CircuitConfig, c, beta: int, gamma: int):
         left = c.mul(lz1, c.mul(c.add_const(pa, beta), c.add_const(pt, gamma)))
         right = c.mul(lz, c.mul(c.add_const(a, beta), c.add_const(tab, gamma)))
         exprs.append(c.mul(act, c.sub(left, right)))
+        # Boundary: lz(last) in {0,1}. Without this the lookup grand product's
+        # final value is unconstrained and the A'~A / T'~T permutation relation
+        # is never enforced (a prover could set A'=T'=table and "look up"
+        # arbitrary advice). Mirrors the permutation z boundary above; lz at
+        # rotation 0 is already in the query plan, so no new openings.
+        exprs.append(c.mul(c.llast, c.sub(c.mul(lz, lz), lz)))
         exprs.append(c.mul(c.l0, c.sub(pa, pt)))
         exprs.append(c.mul(act, c.mul(c.sub(pa, pt), c.sub(pa, pa_prev))))
 

@@ -69,6 +69,24 @@ class _ArrayCtx:
         return B.to_arr([s % R] * self._dom.n_ext)
 
 
+def lookup_grand_product(bk, n: int, u: int, a_v, pa_v, pt_v, t_v,
+                         beta: int, gamma: int) -> list:
+    """Running product z for one lookup column; telescopes to 1 at row u for
+    honest witnesses (asserted — the l_last boundary constraint enforces it
+    in-proof)."""
+    num = bk.mul(bk.add(B.to_arr(a_v), B.to_arr([beta] * n)),
+                 bk.add(B.to_arr(t_v), B.to_arr([gamma] * n)))
+    den = bk.mul(bk.add(B.to_arr(pa_v), B.to_arr([beta] * n)),
+                 bk.add(B.to_arr(pt_v), B.to_arr([gamma] * n)))
+    ratio = B.arr_to_ints(bk.mul(num, bk.inv(den)))
+    for i in range(u, n):
+        ratio[i] = 1
+    prefix = B.arr_to_ints(bk.prefix_prod(B.to_arr(ratio)))
+    z = [1] + prefix[:-1]
+    assert prefix[u - 1] == 1, "lookup product != 1"
+    return z
+
+
 def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
           bk=None, transcript=None) -> bytes:
     bk = bk or B.get_backend()
@@ -161,23 +179,22 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
         prefix_ints = B.arr_to_ints(prefix)
         z = [prev_end] + [prev_end * p % R for p in prefix_ints[:-1]]
         prev_end = prev_end * prefix_ints[u - 1] % R if u >= 1 else prev_end
+        # Blind the tail: every constraint touching z is inactive on rows
+        # u+1..n-1 (act excludes them, llast hits row u, ROT_LAST reads row u),
+        # but z is opened at x and omega*x — deterministic tail rows would leak
+        # witness information halo2 hides. Randomize them.
+        for i in range(u + 1, n):
+            z[i] = secrets.randbelow(R)
         commit_col(("pz", ch), z)
     assert prev_end == 1, "permutation product != 1 (copy constraints unsatisfiable)"
 
     # --- 4. lookup grand products ---
     for j in range(cfg.num_lookup_advice):
-        a_v, pa_v, pt_v = values[("ladv", j)], values[("pA", j)], values[("pT", j)]
-        t_v = pk.table_values[j]
-        num = bk.mul(bk.add(B.to_arr(a_v), B.to_arr([beta] * n)),
-                     bk.add(B.to_arr(t_v), B.to_arr([gamma] * n)))
-        den = bk.mul(bk.add(B.to_arr(pa_v), B.to_arr([beta] * n)),
-                     bk.add(B.to_arr(pt_v), B.to_arr([gamma] * n)))
-        ratio = B.arr_to_ints(bk.mul(num, bk.inv(den)))
-        for i in range(u, n):
-            ratio[i] = 1
-        prefix = B.arr_to_ints(bk.prefix_prod(B.to_arr(ratio)))
-        z = [1] + prefix[:-1]
-        assert prefix[u - 1] == 1, "lookup product != 1"
+        z = lookup_grand_product(
+            bk, n, u, values[("ladv", j)], values[("pA", j)],
+            values[("pT", j)], pk.table_values[j], beta, gamma)
+        for i in range(u + 1, n):        # blind tail rows (see pz above)
+            z[i] = secrets.randbelow(R)
         commit_col(("lz", j), z)
 
     y = tr.challenge()

@@ -199,6 +199,87 @@ class TestBigIntFpChip:
             ecc.load_point(ctx, (bls.Fq(123), bls.Fq(456)))
 
 
+class TestRound2Soundness:
+    """Round-1 ADVICE findings: strict point addition (P==Q forgery),
+    canonical bigint representatives."""
+
+    def _fp(self):
+        from spectre_tpu.builder.fp_chip import FpChip
+        return Context(), FpChip(RangeChip(lookup_bits=8))
+
+    def test_add_unequal_strict_rejects_equal_points(self):
+        from spectre_tpu.builder.fp_chip import EccChip
+        from spectre_tpu.fields import bls12_381 as bls
+        ctx, fp = self._fp()
+        ecc = EccChip(fp)
+        p1 = bls.sk_to_pk(3)
+        c1, c1b = ecc.load_point(ctx, p1), ecc.load_point(ctx, p1)
+        with pytest.raises(AssertionError, match="zero"):
+            ecc.add_unequal(ctx, c1, c1b)  # strict by default
+
+    def test_add_unequal_strict_honest_still_proves(self):
+        from spectre_tpu.builder.fp_chip import EccChip
+        from spectre_tpu.fields import bls12_381 as bls
+        ctx, fp = self._fp()
+        ecc = EccChip(fp)
+        p1, p2 = bls.sk_to_pk(3), bls.sk_to_pk(5)
+        s = ecc.add_unequal(ctx, ecc.load_point(ctx, p1),
+                            ecc.load_point(ctx, p2))
+        want = bls.g1_curve.add(p1, p2)
+        assert (s[0].value, s[1].value) == (int(want[0]), int(want[1]))
+        _mock(ctx, k=13)
+
+    def test_g2_add_unequal_strict_rejects_equal_points(self):
+        from spectre_tpu.builder.fp_chip import FpChip
+        from spectre_tpu.builder.fp2_chip import Fp2Chip, G2Chip
+        from spectre_tpu.fields import bls12_381 as bls
+        ctx = Context()
+        g2 = G2Chip(Fp2Chip(FpChip(RangeChip(lookup_bits=8))))
+        p1 = bls.g2_curve.mul(bls.G2_GEN, 7)
+        c1, c1b = g2.load_point(ctx, p1), g2.load_point(ctx, p1)
+        with pytest.raises(AssertionError, match="zero"):
+            g2.add_unequal(ctx, c1, c1b)
+
+    def test_forged_slope_blocked_by_nonzero_check(self):
+        """The round-1 hole: dx = dy = 0 lets any witnessed slope satisfy
+        q*0 = 0. The strict path's dx*inv == 1 relation has no satisfying
+        witness for dx == 0 — emulating the forger (arbitrary 'inverse' cell)
+        trips the carry-to-zero divisibility, i.e. the identity cannot hold."""
+        from spectre_tpu.fields import bls12_381 as bls, bn254 as bn
+        ctx, fp = self._fp()
+        zero = fp.load(ctx, 0)
+        forged_inv = fp.load(ctx, 99)
+        prod = fp.big.mul_no_carry(ctx, zero, forged_inv)
+        prod0 = fp.gate.add(ctx, prod[0], bn.R - 1)
+        with pytest.raises(AssertionError, match="divisible"):
+            fp.big.check_carry_to_zero(ctx, [prod0] + prod[1:], -1, bls.P)
+
+    def test_assert_nonzero_honest(self):
+        from spectre_tpu.fields import bls12_381 as bls
+        ctx, fp = self._fp()
+        a = fp.load(ctx, 123456789)
+        fp.assert_nonzero(ctx, a)
+        b = fp.load(ctx, bls.P - 1)
+        fp.assert_nonzero(ctx, b)
+        _mock(ctx, k=12)
+
+    def test_canonicalize(self):
+        from spectre_tpu.fields import bls12_381 as bls
+        ctx, fp = self._fp()
+        a = fp.load(ctx, bls.P - 1)
+        fp.canonicalize(ctx, a)
+        _mock(ctx, k=12)
+
+    def test_canonicalize_rejects_p(self):
+        """A residue r = p (non-canonical alias of 0) fits the 381-bit limb
+        range checks but must fail enforce_lt."""
+        from spectre_tpu.fields import bls12_381 as bls
+        ctx, fp = self._fp()
+        a = fp.big.load(ctx, bls.P, max_bits=bls.P.bit_length() + 1)
+        with pytest.raises(AssertionError, match="out of range"):
+            fp.big.enforce_lt(ctx, a, bls.P)
+
+
 class TestShaSoundnessRegressions:
     """The packed-lookup aliasing forgeries found by review must stay dead."""
 

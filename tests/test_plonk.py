@@ -223,6 +223,65 @@ class TestProveVerify:
         assert verify(pk.vk, srs, [[out]], p1) and verify(pk.vk, srs, [[out]], p2)
 
 
+class TestLookupBoundarySoundness:
+    """Round-1 ADVICE high finding: the lookup grand product needs the
+    l_last*(lz^2 - lz) boundary constraint, or a prover who sets A'=T'=table
+    can 'look up' arbitrary out-of-range advice (the permutation relation is
+    never anchored). These keep that hole closed."""
+
+    def test_boundary_term_present_in_expressions(self):
+        from spectre_tpu.plonk.expressions import ScalarCtx, all_expressions
+
+        class _Zeros(dict):
+            def __missing__(self, key):
+                return 0
+
+        cfg = CircuitConfig(k=K, num_advice=1, num_lookup_advice=1,
+                            num_fixed=1, lookup_bits=4)
+        evals = _Zeros()
+        evals[(("lz", 0), 0)] = 2  # lz(last) not in {0,1}
+        # at the l_last row: l0=0, act = 1 - llast - lblind = 0 — every other
+        # constraint vanishes on the all-zero evals, so any nonzero entry IS
+        # the boundary term
+        ctx = ScalarCtx(cfg, evals, l0=0, llast=1, lblind=0, x=0)
+        exprs = all_expressions(cfg, ctx, beta=1, gamma=1)
+        assert any(e % bn.R != 0 for e in exprs), \
+            "lookup boundary constraint missing: lz(last)=2 satisfied everything"
+
+    def test_forged_lookup_rejected(self, srs, monkeypatch):
+        """Replays the round-1 PoC: permuted columns = (table, table), advice
+        contains 99999999, honest-prover asserts bypassed. The boundary
+        constraint must now make the quotient division inexact."""
+        from spectre_tpu.plonk import prover as prover_mod
+
+        cfg = CircuitConfig(k=K, num_advice=1, num_lookup_advice=1,
+                            num_fixed=1, lookup_bits=4)
+        advice, lookup, fixed, selectors, copies, out = _tiny_circuit(cfg)
+        lookup[0][1] = 99999999  # far outside the 4-bit table
+
+        def evil_permute(cfg_, a_vals, t_vals):
+            return list(t_vals), list(t_vals)  # A' = T' = table
+
+        def evil_grand_product(bk, n, u, a_v, pa_v, pt_v, t_v, beta, gamma):
+            num = bk.mul(bk.add(B.to_arr(a_v), B.to_arr([beta] * n)),
+                         bk.add(B.to_arr(t_v), B.to_arr([gamma] * n)))
+            den = bk.mul(bk.add(B.to_arr(pa_v), B.to_arr([beta] * n)),
+                         bk.add(B.to_arr(pt_v), B.to_arr([gamma] * n)))
+            ratio = B.arr_to_ints(bk.mul(num, bk.inv(den)))
+            for i in range(u, n):
+                ratio[i] = 1
+            prefix = B.arr_to_ints(bk.prefix_prod(B.to_arr(ratio)))
+            return [1] + prefix[:-1]  # telescope assert skipped
+
+        monkeypatch.setattr(prover_mod, "permute_lookup", evil_permute)
+        monkeypatch.setattr(prover_mod, "lookup_grand_product",
+                            evil_grand_product)
+        pk = keygen(srs, cfg, fixed, selectors, copies)
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
+        with pytest.raises(AssertionError, match="witness violates"):
+            prove(pk, srs, asg)
+
+
 class TestMockProver:
     def test_satisfied(self):
         from spectre_tpu.plonk.mock import mock_prove
