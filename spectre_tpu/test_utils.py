@@ -69,6 +69,37 @@ def load_step_fixture(path: str) -> SyncStepArgs:
         domain=bytes.fromhex(d["domain"][2:]))
 
 
+def load_reference_step_fixture(path: str) -> SyncStepArgs:
+    """Load a fixture in the upstream layout (`test_data/sync_step_512.json`,
+    produced by `preprocessor/src/unit_test_gen.rs`): byte-array lists for
+    signature/branches/domain, hex-string header fields, 96-byte uncompressed
+    pubkeys. Used as a blst interop oracle (the signatures were produced by
+    the C blst library against the real eth2 ciphersuite)."""
+    with open(path) as f:
+        d = json.load(f)
+
+    hdr = _hdr_from
+    pks = []
+    for raw in d["pubkeys_uncompressed"]:
+        b = bytes(raw)
+        pks.append((int.from_bytes(b[:48], "big"), int.from_bytes(b[48:], "big")))
+    return SyncStepArgs(
+        signature_compressed=bytes(d["signature_compressed"]),
+        pubkeys_uncompressed=pks,
+        participation_bits=[int(bool(b)) for b in d["pariticipation_bits"]],
+        attested_header=hdr(d["attested_header"]),
+        finalized_header=hdr(d["finalized_header"]),
+        finality_branch=[bytes(b) for b in d["finality_branch"]],
+        execution_payload_root=bytes(d["execution_payload_root"]),
+        execution_payload_branch=[bytes(b) for b in d["execution_payload_branch"]],
+        domain=bytes(d["domain"]))
+
+
+REFERENCE_STEP_FIXTURE = os.environ.get(
+    "SPECTRE_REFERENCE_STEP_FIXTURE",
+    "/root/reference/test_data/sync_step_512.json")
+
+
 def dump_rotation_fixture(args: CommitteeUpdateArgs, path: str):
     data = {
         "pubkeys_compressed": ["0x" + pk.hex() for pk in args.pubkeys_compressed],

@@ -118,6 +118,61 @@ class TestBLS12381:
         assert len(out) == 100
         assert out != bls.expand_message_xmd(b"abd", b"DST", 100)
 
+    def test_h_eff_structure(self):
+        # h_eff must (a) clear the cofactor: h_eff*P lands in the r-order
+        # subgroup for any curve point, and (b) act as a UNIT mod r (else
+        # hash outputs would collapse to infinity)
+        x, y = bls._deterministic_twist_points(1)[0]
+        pt = (x, y)
+        assert not bls.g2_curve.in_subgroup(pt) or True  # generic point
+        cleared = bls.g2_curve.mul_unsafe(pt, bls.H_EFF_G2)
+        assert cleared is None or bls.g2_curve.in_subgroup(cleared)
+        assert bls.H_EFF_G2 % bls.R != 0
+        # consistency with the plain cofactor: same subgroup image family
+        h2c = bls.clear_cofactor_g2(pt)
+        assert h2c is None or bls.g2_curve.in_subgroup(h2c)
+
+    def test_svdw_variant_still_sound(self):
+        # the round-1 SvdW path stays available (documented alternative);
+        # it must still land in G2 and differ from the SSWU suite
+        h = bls.hash_to_g2_svdw(b"svdw smoke")
+        assert bls.g2_curve.in_subgroup(h)
+        assert h != bls.hash_to_g2(b"svdw smoke")
+
+    def test_sswu_iso_derivation_consistent(self):
+        # the Velu-derived kernel/isogeny must reproduce the pinned
+        # normalization constant among its c^6 = B2/b'' roots, and the map
+        # must land on E2 (on-curve) for arbitrary field inputs
+        xq, t, uq, cs = bls._iso3_constants()
+        assert bls._ISO3_C in cs
+        u = bls.Fq2([12345, 67890])
+        p_iso = bls.map_to_curve_sswu_g2prime(u)
+        # on the isogenous curve E2'
+        x, y = p_iso
+        assert y * y == x * x * x + bls.SSWU_A * x + bls.SSWU_B
+        q = bls.iso3_map(p_iso)
+        assert bls.g2_curve.is_on_curve(q)
+
+    def test_sswu_matches_blst_fixture(self):
+        """Interop anchor: the upstream 512-validator fixture was signed by
+        the C blst library with the real eth2 ciphersuite. Our full pipeline
+        (signing root -> hash_to_g2 SSWU -> pairing check) must accept it —
+        this pins expand_message, SSWU, the derived isogeny, sgn0, h_eff, and
+        the pairing all at once."""
+        import os
+        from spectre_tpu.test_utils import (REFERENCE_STEP_FIXTURE,
+                                            load_reference_step_fixture)
+        if not os.path.exists(REFERENCE_STEP_FIXTURE):
+            pytest.skip("reference fixture unavailable")
+        args = load_reference_step_fixture(REFERENCE_STEP_FIXTURE)
+        sig = bls.g2_decompress(args.signature_compressed)
+        pks = [(bls.Fq(x), bls.Fq(y))
+               for (x, y), bit in zip(args.pubkeys_uncompressed,
+                                      args.participation_bits) if bit]
+        assert bls.fast_aggregate_verify(pks, args.signing_root(), sig)
+        # and a mutated message must NOT verify
+        assert not bls.fast_aggregate_verify(pks, b"\x00" * 32, sig)
+
 
 class TestBLSSignatures:
     def test_aggregate_sign_verify(self):
