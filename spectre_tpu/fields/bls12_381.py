@@ -418,6 +418,8 @@ def iso3_map(pt):
     c2, c3 = c * c, c * c * c
     x, y = pt
     dx = x - xq
+    if dx.is_zero():
+        return None  # kernel point: iso_map sends it to the identity (RFC 9380)
     dxi = dx.inv()
     dxi2 = dxi * dxi
     xx = x + t * dxi + uq * dxi2

@@ -3,14 +3,19 @@
 Reference parity: `pse-poseidon` (native) and halo2-base `PoseidonSponge`
 (in-circuit), with the spectre sponge shape pinned in
 `lightclient-circuits/src/poseidon.rs:22-30`: T=12, RATE=11, R_F=8, R_P=65,
-x^5 S-box. Round constants and the MDS matrix are generated by the Grain LFSR
-procedure from the Poseidon reference implementation
-(generate_parameters_grain.sage), which pse-poseidon follows. NOTE: exact
-constant parity with pse-poseidon cannot be validated in this environment
-(crate not vendored, no network) — all uses inside this framework (native
-commitment <-> in-circuit chip <-> preprocessor) are mutually consistent, and
-the generation procedure is the published one. Flagged for cross-checking when
-reference artifacts are available.
+x^5 S-box. Round constants and the MDS matrix follow the halo2-base /
+zcash-halo2 Grain procedure the reference instantiates (`poseidon.rs:79`
+`PoseidonSponge::new::<R_F, R_P, 0>` -> `OptimizedPoseidonSpec` ->
+`generate_constants`/`generate_mds` with SECURE_MDS=0): rejection-sampled
+MSB-first round constants; non-rejected LSB-first MDS xs/ys (batch-retried on
+duplicates); Cauchy matrix 1/(x_i + y_j). The optimized-spec rewrite the Rust
+side applies for sparse partial rounds is an equivalence transform, so the
+naive schedule here produces identical permutation outputs. NOTE: final
+byte-parity vs pse-poseidon needs an oracle this offline environment lacks
+(no Rust toolchain, no vendored crate, no published T=12 vectors); golden
+vectors of THIS derivation are pinned in tests/test_ops.py so any future
+drift is loud, and the derivation is cross-checkable the moment an oracle
+appears.
 
 The sponge construction (rate-11 "onion" absorb over committee pubkeys) lives
 in gadgets/poseidon_commit.py; this module is the permutation itself.
@@ -70,6 +75,9 @@ class GrainLFSR:
                 return b2
 
     def next_field_element(self, p: int, nbits: int) -> int:
+        """Rejection-sampled element, bits MSB-first (used for round
+        constants — matches the Poseidon reference generator and
+        zcash-halo2/halo2-base `Grain::next_field_element`)."""
         while True:
             v = 0
             for _ in range(nbits):
@@ -77,24 +85,48 @@ class GrainLFSR:
             if v < p:
                 return v
 
+    def next_field_element_without_rejection(self, p: int, nbits: int) -> int:
+        """Non-rejected element, bits packed LSB-first then wide-reduced
+        (zcash-halo2/halo2-base `next_field_element_without_rejection`,
+        used for the MDS xs/ys): bit i goes to byte i//8 bit i%8 of a
+        64-byte little-endian buffer, interpreted mod p."""
+        v = 0
+        for i in range(nbits):
+            v |= self.next_filtered_bit() << i
+        return v % p
+
 
 def _to_bits(v: int, n: int):
     return [(v >> (n - 1 - i)) & 1 for i in range(n)]
 
 
 @functools.cache
-def constants(t: int = T, r_f: int = R_F, r_p: int = R_P):
-    """(round_constants [(r_f + r_p) * t], mds [t][t]) over Fr."""
+def constants(t: int = T, r_f: int = R_F, r_p: int = R_P,
+              secure_mds: int = 0):
+    """(round_constants [(r_f + r_p) * t], mds [t][t]) over Fr.
+
+    Generation follows halo2-base `OptimizedPoseidonSpec::new::<R_F,R_P,0>`
+    (= zcash-halo2 `generate_constants` + `generate_mds`, the code path the
+    reference instantiates in `poseidon.rs:79` via
+    `PoseidonSponge::<F,T,RATE>::new::<R_F,R_P,0>`): round constants by
+    MSB-first rejection sampling; MDS xs/ys by LSB-first non-rejected
+    sampling, retried as a whole 2t batch until all 2t values are distinct,
+    with `secure_mds` initial batches discarded (the reference uses 0);
+    mds[i][j] = 1/(xs[i]+ys[j])."""
     nbits = R.bit_length()  # 254
     lfsr = GrainLFSR(nbits, t, r_f, r_p)
     rc = [lfsr.next_field_element(R, nbits) for _ in range((r_f + r_p) * t)]
-    # Cauchy MDS from grain-sampled xs/ys (retry on degenerate pairs)
+    select = secure_mds
     while True:
-        xs = [lfsr.next_field_element(R, nbits) for _ in range(t)]
-        ys = [lfsr.next_field_element(R, nbits) for _ in range(t)]
-        seen = set(xs) | set(ys)
-        if len(seen) == 2 * t and all((x - y) % R != 0 for x in xs for y in ys):
-            break
+        vals = [lfsr.next_field_element_without_rejection(R, nbits)
+                for _ in range(2 * t)]
+        if len(set(vals)) != 2 * t:
+            continue
+        if select != 0:
+            select -= 1
+            continue
+        xs, ys = vals[:t], vals[t:]
+        break
     mds = [[pow((xs[i] + ys[j]) % R, -1, R) for j in range(t)] for i in range(t)]
     return rc, mds
 

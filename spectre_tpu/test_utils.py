@@ -78,7 +78,7 @@ def load_reference_step_fixture(path: str) -> SyncStepArgs:
     with open(path) as f:
         d = json.load(f)
 
-    hdr = _hdr_from
+    hdr = _hdr_from  # upstream header JSON uses the same hex-field layout
     pks = []
     for raw in d["pubkeys_uncompressed"]:
         b = bytes(raw)

@@ -201,6 +201,22 @@ class TestPoseidon:
         # cheap sanity: no duplicate rows
         assert len({tuple(r) for r in mds}) == POS.T
 
+    def test_golden_vectors_pinned(self):
+        """Pinned outputs of the halo2-base-procedure Grain derivation
+        (T=12, RATE=11, R_F=8, R_P=65, SECURE_MDS=0). These are derived
+        in-repo (no external oracle available offline — see module note);
+        pinning makes ANY drift in the generation procedure loud, and gives
+        the cross-check target for when a pse-poseidon oracle is available."""
+        rc, mds = POS.constants()
+        assert rc[0] == 0x2F8B21C35B9D040439B4A4C99454409736FE5CE816A8150E6E27E30E2C886A9B
+        assert rc[-1] == 0x24E539B23BAD276B2DAFB1E5C8F68C7B1E03AE757923A01D3C62233927647CA4
+        assert mds[0][0] == 0x1B3C91FF6B67F23544228B250E678D20A3122EF1607685B28AF981E84F6DE352
+        sp = POS.PoseidonSponge()
+        sp.absorb([1, 2, 3])
+        assert sp.squeeze() == 0x1B7F414A1AC0F4662FA50E8BA7BD7ED853D2591C20DF0ED3F4610CCDC9048C9E
+        assert POS.permute_native([0] * 12)[0] == \
+            0x24DA301E2F781BD5A7CD94470F24A69843EEEF45AE7FAE411482F431567A2A44
+
 
 class TestMSMBatch:
     def test_matches_single(self):
