@@ -34,6 +34,24 @@ class CrtUint:
     value: int
 
 
+@dataclass
+class OverflowInt:
+    """Lazily-reduced big integer: limb cells whose SIGNED values may exceed
+    LIMB_BITS (products, sums, differences accumulated without carrying).
+    halo2-ecc's CRTInteger-with-overflow role: the pairing tower does many
+    no-carry ops per single carry_mod, which is where non-native field
+    arithmetic gets its constraint budget back.
+
+    value: exact signed integer; limb_abs: bound on each |limb| (signed
+    interpretation); val_abs: bound on |value| (sizes the reduction
+    quotient)."""
+
+    limbs: list
+    value: int
+    limb_abs: int
+    val_abs: int
+
+
 class BigUintChip:
     def __init__(self, rng: RangeChip):
         self.rng = rng
@@ -83,6 +101,162 @@ class BigUintChip:
             out.append(self.gate.inner_product(ctx, terms_a, terms_b))
         return out
 
+    # -- lazy (no-carry) arithmetic on OverflowInt ----------------------
+    def to_overflow(self, a, val_bits: int = NUM_LIMBS * LIMB_BITS) -> OverflowInt:
+        if isinstance(a, OverflowInt):
+            return a
+        return OverflowInt(list(a.limbs), a.value, BASE - 1, 1 << val_bits)
+
+    def mul_ovf(self, ctx: Context, a, b,
+                val_bits: int = NUM_LIMBS * LIMB_BITS) -> OverflowInt:
+        """Product as overflowed limbs (no reduction). a, b: CrtUint or
+        OverflowInt. val_bits bounds each CrtUint operand's |value| — pass
+        the tight field bound (e.g. 381 for reduced Fq elements): the
+        reduction quotient is sized from it, and the 5-limb quotient caps
+        honest accumulations at |value| < ~2^515."""
+        xa, xb = self.to_overflow(a, val_bits), self.to_overflow(b, val_bits)
+        la, lb = len(xa.limbs), len(xb.limbs)
+        out = []
+        for k in range(la + lb - 1):
+            terms_a, terms_b = [], []
+            for i in range(max(0, k - lb + 1), min(la, k + 1)):
+                terms_a.append(xa.limbs[i])
+                terms_b.append(xb.limbs[k - i])
+            out.append(self.gate.inner_product(ctx, terms_a, terms_b))
+        return OverflowInt(out, xa.value * xb.value,
+                           min(la, lb) * xa.limb_abs * xb.limb_abs,
+                           xa.val_abs * xb.val_abs)
+
+    def mul_ovf_const(self, ctx: Context, a, k: int,
+                      val_bits: int = NUM_LIMBS * LIMB_BITS) -> OverflowInt:
+        """Product with a non-negative host constant, as a constant-limb
+        convolution (inner_product_const — no witness cells for k)."""
+        assert k >= 0
+        xa = self.to_overflow(a, val_bits)
+        if k == 0:
+            zero = ctx.load_constant(0)
+            return OverflowInt([zero], 0, 0, 1)
+        k_limbs = []
+        rem = k
+        while rem:
+            k_limbs.append(rem & (BASE - 1))
+            rem >>= LIMB_BITS
+        la, lb = len(xa.limbs), len(k_limbs)
+        out = []
+        for kk in range(la + lb - 1):
+            terms, consts = [], []
+            for i in range(max(0, kk - lb + 1), min(la, kk + 1)):
+                terms.append(xa.limbs[i])
+                consts.append(k_limbs[kk - i])
+            out.append(self.gate.inner_product_const(ctx, terms, consts))
+        return OverflowInt(out, xa.value * k,
+                           min(la, lb) * xa.limb_abs * (BASE - 1),
+                           xa.val_abs * k)
+
+    def add_ovf(self, ctx: Context, x: OverflowInt, y: OverflowInt) -> OverflowInt:
+        gate = self.gate
+        n = max(len(x.limbs), len(y.limbs))
+        limbs = []
+        for k in range(n):
+            if k >= len(x.limbs):
+                limbs.append(y.limbs[k])
+            elif k >= len(y.limbs):
+                limbs.append(x.limbs[k])
+            else:
+                limbs.append(gate.add(ctx, x.limbs[k], y.limbs[k]))
+        return OverflowInt(limbs, x.value + y.value,
+                           x.limb_abs + y.limb_abs, x.val_abs + y.val_abs)
+
+    def sub_ovf(self, ctx: Context, x: OverflowInt, y: OverflowInt) -> OverflowInt:
+        gate = self.gate
+        n = max(len(x.limbs), len(y.limbs))
+        limbs = []
+        for k in range(n):
+            if k >= len(x.limbs):
+                limbs.append(gate.neg(ctx, y.limbs[k]))
+            elif k >= len(y.limbs):
+                limbs.append(x.limbs[k])
+            else:
+                limbs.append(gate.sub(ctx, x.limbs[k], y.limbs[k]))
+        return OverflowInt(limbs, x.value - y.value,
+                           x.limb_abs + y.limb_abs, x.val_abs + y.val_abs)
+
+    def scale_ovf(self, ctx: Context, x: OverflowInt, c: int) -> OverflowInt:
+        """Multiply by a small non-negative host constant."""
+        assert c >= 0
+        gate = self.gate
+        limbs = [gate.mul(ctx, l, c) for l in x.limbs]
+        return OverflowInt(limbs, x.value * c, x.limb_abs * c, x.val_abs * c)
+
+    def carry_mod_ovf(self, ctx: Context, x: OverflowInt, p: int) -> CrtUint:
+        """Reduce an OverflowInt to a canonical-width CrtUint mod p. Handles
+        negative values by first adding a constant multiple of p (limb-wise
+        constant adds), then runs the usual CRT carry chain with carry widths
+        sized from the tracked limb bound."""
+        gate = self.gate
+        limbs, value = list(x.limbs), x.value
+        limb_abs = x.limb_abs
+        assert abs(value) <= x.val_abs, "OverflowInt value bound violated"
+        # shift by k*p >= val_abs so the quotient is non-negative for any
+        # honest value (constant limb adds; constraints unchanged in kind)
+        k = (x.val_abs + p - 1) // p
+        shift = k * p
+        s_limbs = []
+        rem = shift
+        nl = max(len(limbs), NUM_LIMBS)
+        for i in range(nl - 1):
+            s_limbs.append(rem & (BASE - 1))
+            rem >>= LIMB_BITS
+        s_limbs.append(rem)   # top limb takes the remainder (constant)
+        while len(limbs) < len(s_limbs):
+            limbs.append(ctx.load_constant(0))
+        for i, sv in enumerate(s_limbs):
+            if sv:
+                limbs[i] = gate.add(ctx, limbs[i], sv % R)
+        value = value + shift
+        limb_abs = limb_abs + max(s_limbs)
+        assert value >= 0
+        q_val, r_val = divmod(value, p)
+        # q <= (val_abs + shift)/p < 2*val_abs/p + 1
+        q_bits = max((x.val_abs * 2).bit_length() - p.bit_length() + 1, 8)
+        assert q_bits <= NUM_LIMBS * LIMB_BITS, \
+            "OverflowInt accumulation too large for the 5-limb quotient — " \
+            "reduce earlier or tighten val_bits"
+        assert q_val < (1 << q_bits)
+        q = self.load(ctx, q_val, max_bits=q_bits)
+        r = self.load(ctx, r_val, max_bits=p.bit_length())
+
+        ntot = max(len(limbs), 2 * NUM_LIMBS - 1)
+        qp_limbs = self._qp_identity(ctx, q, p)
+        zero = None
+        while len(limbs) < ntot:
+            zero = zero or ctx.load_constant(0)
+            limbs.append(zero)
+        while len(qp_limbs) < ntot:
+            zero = zero or ctx.load_constant(0)
+            qp_limbs.append(zero)
+        self._native_zero(ctx, limbs, qp_limbs, r)
+
+        assert len(limbs) <= 2 * NUM_LIMBS - 1, "too many overflow limbs"
+        # limb-radix identity with carry widths sized from the limb bound
+        qp_abs = NUM_LIMBS * (BASE - 1) ** 2
+        max_t = limb_abs + qp_abs + BASE
+        carry_bits = max(max_t.bit_length() - LIMB_BITS + 1, 2)
+        # no mod-R wraparound in the chain: t + carry + offset*BASE must
+        # stay far below R
+        assert carry_bits + 2 + LIMB_BITS < 250, "overflow limbs too wide"
+        t_cells, t_vals = [], []
+        for k in range(ntot):
+            tv = _signed(_val_of(limbs[k])) - _signed(_val_of(qp_limbs[k]))
+            tc = gate.sub(ctx, limbs[k], qp_limbs[k])
+            if k < NUM_LIMBS:
+                tv -= r.limbs[k].value
+                tc = gate.sub(ctx, tc, r.limbs[k])
+            t_cells.append(tc)
+            t_vals.append(tv)
+        self._carry_chain_zero(ctx, t_cells, t_vals, carry_bits=carry_bits)
+        return r
+
     # -- the CRT reduction ---------------------------------------------
     def carry_mod(self, ctx: Context, prod_limbs: list, prod_value: int,
                   p: int) -> CrtUint:
@@ -95,23 +269,9 @@ class BigUintChip:
         q = self.load(ctx, q_val, max_bits=p.bit_length() + 8)
         r = self.load(ctx, r_val, max_bits=p.bit_length())
 
-        # q*p limb convolution with CONSTANT p limbs
-        p_limbs = [(p >> (LIMB_BITS * i)) & (BASE - 1) for i in range(NUM_LIMBS)]
-        qp_limbs = []
-        for k in range(2 * NUM_LIMBS - 1):
-            terms, consts = [], []
-            for i in range(max(0, k - NUM_LIMBS + 1), min(NUM_LIMBS, k + 1)):
-                terms.append(q.limbs[i])
-                consts.append(p_limbs[k - i])
-            qp_limbs.append(gate.inner_product_const(ctx, terms, consts))
-
-        # (a) native identity: X - q*p - r == 0 (mod r)
-        x_native = gate.inner_product_const(
-            ctx, prod_limbs, self._pow_native[:len(prod_limbs)])
-        qp_native = gate.inner_product_const(
-            ctx, qp_limbs, self._pow_native[:len(qp_limbs)])
-        lhs = gate.sub(ctx, gate.sub(ctx, x_native, qp_native), r.native)
-        ctx.constrain_constant(lhs, 0)
+        # (a) q*p convolution + native identity: X - q*p - r == 0 (mod r)
+        qp_limbs = self._qp_identity(ctx, q, p)
+        self._native_zero(ctx, prod_limbs, qp_limbs, r)
 
         # (b) limb-radix identity via carries:
         #     t_k = X_k - (qp)_k - r_k ;  t_k + c_{k-1} = c_k * 2^LIMB_BITS
@@ -129,6 +289,32 @@ class BigUintChip:
             t_cells.append(t_cell)
         self._carry_chain_zero(ctx, t_cells, t_vals)
         return r
+
+    def _qp_identity(self, ctx: Context, q: CrtUint, p: int):
+        """The q*p constant-limb convolution (shared by every reduction)."""
+        gate = self.gate
+        p_limbs = [(p >> (LIMB_BITS * i)) & (BASE - 1) for i in range(NUM_LIMBS)]
+        qp_limbs = []
+        for k in range(2 * NUM_LIMBS - 1):
+            terms, consts = [], []
+            for i in range(max(0, k - NUM_LIMBS + 1), min(NUM_LIMBS, k + 1)):
+                terms.append(q.limbs[i])
+                consts.append(p_limbs[k - i])
+            qp_limbs.append(gate.inner_product_const(ctx, terms, consts))
+        return qp_limbs
+
+    def _native_zero(self, ctx: Context, x_limbs: list, qp_limbs: list,
+                     r: CrtUint | None):
+        """Constrain sum(x)*B^k - sum(qp)*B^k - r == 0 (mod native r)."""
+        gate = self.gate
+        x_native = gate.inner_product_const(
+            ctx, x_limbs, self._pow_native[:len(x_limbs)])
+        qp_native = gate.inner_product_const(
+            ctx, qp_limbs, self._pow_native[:len(qp_limbs)])
+        lhs = gate.sub(ctx, x_native, qp_native)
+        if r is not None:
+            lhs = gate.sub(ctx, lhs, r.native)
+        ctx.constrain_constant(lhs, 0)
 
     def _carry_chain_zero(self, ctx: Context, t_cells: list, t_vals: list,
                           carry_bits: int | None = None):
@@ -172,19 +358,8 @@ class BigUintChip:
         # same static shape as carry_mod's quotient (shape must not depend on
         # the witness): products of reduced operands give q < ~L * 2^(2*104) / p
         q = self.load(ctx, q_val, max_bits=p.bit_length() + 8)
-        p_limbs = [(p >> (LIMB_BITS * i)) & (BASE - 1) for i in range(NUM_LIMBS)]
-        qp_limbs = []
-        for k in range(2 * NUM_LIMBS - 1):
-            terms, consts = [], []
-            for i in range(max(0, k - NUM_LIMBS + 1), min(NUM_LIMBS, k + 1)):
-                terms.append(q.limbs[i])
-                consts.append(p_limbs[k - i])
-            qp_limbs.append(gate.inner_product_const(ctx, terms, consts))
-        x_native = gate.inner_product_const(
-            ctx, prod_limbs, self._pow_native[:len(prod_limbs)])
-        qp_native = gate.inner_product_const(
-            ctx, qp_limbs, self._pow_native[:len(qp_limbs)])
-        ctx.constrain_constant(gate.sub(ctx, x_native, qp_native), 0)
+        qp_limbs = self._qp_identity(ctx, q, p)
+        self._native_zero(ctx, prod_limbs, qp_limbs, None)
         t_cells, t_vals = [], []
         for k in range(2 * NUM_LIMBS - 1):
             t_vals.append(_signed(_val_of(prod_limbs[k])) -

@@ -20,17 +20,17 @@ from ..plonk.constraint_system import Assignment, CircuitConfig
 R = bn254.R
 
 
-@dataclass(frozen=True)
 class AssignedValue:
-    """Handle to a stream cell: (stream id, index). value is a cached int."""
+    """Handle to a stream cell: (stream id, index, cached value). Cells are
+    immutable once appended, so the value is stored directly (the dataclass/
+    property indirection dominated witness-gen profiles)."""
 
-    ctx: "Context"
-    stream: str      # always "adv" (lookup streams hold raw copies, no handles)
-    index: int
+    __slots__ = ("stream", "index", "value")
 
-    @property
-    def value(self) -> int:
-        return self.ctx.stream_values(self.stream)[self.index]
+    def __init__(self, stream: str, index: int, value: int):
+        self.stream = stream    # always "adv" (lookup streams hold raw copies)
+        self.index = index
+        self.value = value
 
     def __repr__(self):
         return f"AV({self.stream}[{self.index}]=0x{self.value:x})"
@@ -60,15 +60,20 @@ class Context:
         return start
 
     def load_witness(self, v: int) -> AssignedValue:
-        start = self._push_unit([v], gated=False)
-        return AssignedValue(self, "adv", start)
+        v = int(v) % R
+        start = len(self.adv_values)
+        self.adv_values.append(v)
+        self.adv_units.append((start, 1, False))
+        return AssignedValue("adv", start, v)
 
     def load_constant(self, v: int) -> AssignedValue:
         v = int(v) % R
-        start = self._push_unit([v], gated=False)
+        start = len(self.adv_values)
+        self.adv_values.append(v)
+        self.adv_units.append((start, 1, False))
         row = self.constants.setdefault(v, len(self.constants))
         self.const_uses.append((start, row))
-        return AssignedValue(self, "adv", start)
+        return AssignedValue("adv", start, v)
 
     def load_zero(self) -> AssignedValue:
         return self.load_constant(0)
@@ -78,17 +83,42 @@ class Context:
         an AssignedValue (equality to an existing cell), or ("const", v)."""
         assert len(vals) == 4
         start = self._push_unit(vals, gated=True)
+        adv = self.adv_values
         out = []
         for i, src in enumerate(copy_from):
-            av = AssignedValue(self, "adv", start + i)
+            av = AssignedValue("adv", start + i, adv[start + i])
             if isinstance(src, AssignedValue):
-                assert src.value == vals[i] % R, "copy value mismatch"
+                assert src.value == adv[start + i], "copy value mismatch"
                 self.copies.append(((src.stream, src.index), ("adv", start + i)))
             elif isinstance(src, tuple) and src and src[0] == "const":
                 row = self.constants.setdefault(src[1] % R, len(self.constants))
                 self.const_uses.append((start + i, row))
             out.append(av)
         return out
+
+    def gate_unit_out(self, v0: int, v1: int, v2: int, v3: int,
+                      s0, s1, s2, s3, out_i: int) -> AssignedValue:
+        """Fast path: append one gated unit, return ONLY the out_i cell.
+        Sources s0..s3: None (fresh), AssignedValue (copy), or an int
+        (constant-pin). Values must already be reduced mod R."""
+        start = len(self.adv_values)
+        adv = self.adv_values
+        adv.append(v0), adv.append(v1), adv.append(v2), adv.append(v3)
+        self.adv_units.append((start, 4, True))
+        copies = self.copies
+        const_uses = self.const_uses
+        constants = self.constants
+        i = start
+        for src in (s0, s1, s2, s3):
+            if src is not None:
+                if src.__class__ is AssignedValue:
+                    assert src.value == adv[i], "copy value mismatch"
+                    copies.append(((src.stream, src.index), ("adv", i)))
+                else:  # int constant
+                    row = constants.setdefault(src, len(constants))
+                    const_uses.append((i, row))
+            i += 1
+        return AssignedValue("adv", start + out_i, adv[start + out_i])
 
     def push_lookup(self, av: AssignedValue) -> None:
         """Copy a cell into the range-table lookup stream."""

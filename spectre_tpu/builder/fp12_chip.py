@@ -1,0 +1,206 @@
+"""Fp12 tower chip: BLS12-381 Fq12 arithmetic over BN254 Fr cells.
+
+Reference parity: halo2-ecc `Fp12Chip` (SURVEY.md L0; the pairing layer of
+`sync_step_circuit.rs:171` `assert_valid_signature`). Tower: Fq12 =
+Fq2[w]/(w^6 - xi), xi = 1 + u — consistent with the host poly basis
+(fields/bls12_381.py: u = w^6 - 1), so host<->tower conversion is linear.
+
+Elements are 6-tuples of reduced Fq2 pairs ((CrtUint, CrtUint) each).
+Multiplication runs in the LAZY domain (Fp2Lazy): 36 coefficient products
+accumulated without carries, ONE carry_mod per output coefficient limb pair
+(12 total) — the constraint-count backbone of the in-circuit pairing.
+
+Frobenius constants gamma1/gamma2 and the p^6 conjugation sign are derived
+from xi at import (no opaque tables); `tests/test_builder.py` checks chip
+arithmetic against the host Fq12 through the tower<->poly conversion.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..fields import bls12_381 as bls
+from .context import Context
+from .fp2_chip import Fp2Chip, Fp2Lazy
+
+P = bls.P
+XI = bls.Fq2([1, 1])
+
+
+# ---------------------------------------------------------------------------
+# host-side tower <-> poly-basis conversion (for witnesses and test oracles)
+# ---------------------------------------------------------------------------
+
+def tower_to_fq12(coeffs) -> "bls.Fq12":
+    """[6 x Fq2] tower coords -> host poly-basis Fq12 (u = w^6 - 1)."""
+    c = [0] * 12
+    for i, a in enumerate(coeffs):
+        a0, a1 = int(a.c[0]), int(a.c[1])
+        c[i] = (c[i] + a0 - a1) % P
+        c[i + 6] = (c[i + 6] + a1) % P
+    return bls.Fq12(c)
+
+
+def fq12_to_tower(x: "bls.Fq12"):
+    """Host poly-basis Fq12 -> [6 x Fq2] tower coords."""
+    c = x.c
+    return [bls.Fq2([(c[i] + c[i + 6]) % P, c[i + 6]]) for i in range(6)]
+
+
+@functools.cache
+def frobenius_constants():
+    """(gamma1[i], gamma2[i], i=0..5): xi^(i(p-1)/6) and xi^(i(p^2-1)/6).
+    Conjugation sign for p^6 is -1 (asserted — xi^((p^6-1)/6) = -1)."""
+    g1 = [XI ** ((i * (P - 1)) // 6) for i in range(6)]
+    g2 = [XI ** ((i * (P * P - 1)) // 6) for i in range(6)]
+    assert XI ** ((P ** 6 - 1) // 6) == bls.Fq2([P - 1, 0])
+    return g1, g2
+
+
+class Fp12Chip:
+    def __init__(self, fp2: Fp2Chip):
+        self.fp2 = fp2
+        self.lazy = Fp2Lazy(fp2)
+
+    # -- loading --------------------------------------------------------
+    def load(self, ctx: Context, coeffs) -> tuple:
+        """coeffs: [6 x Fq2] tower coordinates (or host Fq12)."""
+        if isinstance(coeffs, bls.Fq12):
+            coeffs = fq12_to_tower(coeffs)
+        return tuple(self.fp2.load(ctx, a) for a in coeffs)
+
+    def load_constant(self, ctx: Context, coeffs) -> tuple:
+        if isinstance(coeffs, bls.Fq12):
+            coeffs = fq12_to_tower(coeffs)
+        return tuple(self.fp2.load_constant(ctx, a) for a in coeffs)
+
+    def one(self, ctx: Context) -> tuple:
+        return self.load_constant(ctx, [bls.Fq2([1, 0])] + [bls.Fq2([0, 0])] * 5)
+
+    def value(self, a) -> "bls.Fq12":
+        return tower_to_fq12([self.fp2.value(c) for c in a])
+
+    # -- arithmetic ------------------------------------------------------
+    def mul(self, ctx: Context, a, b) -> tuple:
+        """Schoolbook over w-slots, lazy: S_k = sum_{i+j=k} a_i b_j;
+        c_k = S_k + xi * S_{k+6}; 12 reductions total. Karatsuba operand
+        sums are hoisted per coefficient (each is reused 6 times)."""
+        lz = self.lazy
+        sums_a = [lz.coeff_sum(ctx, a[i]) for i in range(6)]
+        sums_b = [lz.coeff_sum(ctx, b[j]) for j in range(6)]
+        s = [None] * 11
+        for i in range(6):
+            for j in range(6):
+                t = lz.mul(ctx, a[i], b[j], sa=sums_a[i], sb=sums_b[j])
+                k = i + j
+                s[k] = t if s[k] is None else lz.add(ctx, s[k], t)
+        out = []
+        for k in range(6):
+            acc = s[k]
+            if k + 6 <= 10 and s[k + 6] is not None:
+                acc = lz.add(ctx, acc, lz.mul_by_xi(ctx, s[k + 6]))
+            out.append(lz.reduce(ctx, acc))
+        return tuple(out)
+
+    def square(self, ctx: Context, a) -> tuple:
+        """Symmetric schoolbook: 21 Fq2 products (6 diagonal + 15 doubled
+        cross terms) instead of 36."""
+        lz = self.lazy
+        big = lz.big
+        sums = [lz.coeff_sum(ctx, a[i]) for i in range(6)]
+        s = [None] * 11
+        for i in range(6):
+            for j in range(i, 6):
+                t = lz.mul(ctx, a[i], a[j], sa=sums[i], sb=sums[j])
+                if j > i:
+                    t = (big.scale_ovf(ctx, t[0], 2), big.scale_ovf(ctx, t[1], 2))
+                k = i + j
+                s[k] = t if s[k] is None else lz.add(ctx, s[k], t)
+        out = []
+        for k in range(6):
+            acc = s[k]
+            if k + 6 <= 10 and s[k + 6] is not None:
+                acc = lz.add(ctx, acc, lz.mul_by_xi(ctx, s[k + 6]))
+            out.append(lz.reduce(ctx, acc))
+        return tuple(out)
+
+    def conjugate(self, ctx: Context, a) -> tuple:
+        """f^(p^6): w -> -w (gamma6 = -1): negate odd slots."""
+        fp2 = self.fp2
+        out = []
+        for i, c in enumerate(a):
+            out.append(fp2.neg(ctx, c) if i % 2 else c)
+        return tuple(out)
+
+    def frobenius(self, ctx: Context, a, power: int = 1) -> tuple:
+        """f^(p^power) for power in {1, 2}: coefficient-wise Fq2 frobenius
+        (conjugation for odd power) then gamma constant mul. (The final
+        exponentiation needs only these two powers.)"""
+        assert power in (1, 2)
+        g1, g2 = frobenius_constants()
+        fp2, lz = self.fp2, self.lazy
+        out = []
+        for i, c in enumerate(a):
+            if power == 1:
+                cc, k = fp2.conjugate(ctx, c), g1[i]
+            else:
+                cc, k = c, g2[i]
+            out.append(lz.reduce(ctx, lz.mul_const(ctx, cc, k)))
+        return tuple(out)
+
+    def mul_sparse_035(self, ctx: Context, f, c0, c3, c5) -> tuple:
+        """f * (c0 + c3 w^3 + c5 w^5) where c0/c3/c5 are REDUCED Fq2 pairs
+        (the Miller line shape for the M-twist with 1/w folding; see
+        pairing_chip). 18 Fq2 products, 12 reductions."""
+        lz = self.lazy
+        s = [None] * 11
+        sums_f = [lz.coeff_sum(ctx, f[i]) for i in range(6)]
+        sum_c0 = lz.coeff_sum(ctx, c0)
+        sum_c3 = lz.coeff_sum(ctx, c3)
+        sum_c5 = lz.coeff_sum(ctx, c5)
+
+        def acc(k, t):
+            s[k] = t if s[k] is None else lz.add(ctx, s[k], t)
+
+        for i in range(6):
+            fi, sfi = f[i], sums_f[i]
+            acc(i, lz.mul(ctx, fi, c0, sa=sfi, sb=sum_c0))
+            acc(i + 3, lz.mul(ctx, fi, c3, sa=sfi, sb=sum_c3))
+            acc(i + 5, lz.mul(ctx, fi, c5, sa=sfi, sb=sum_c5))
+        out = []
+        for k in range(6):
+            a = s[k]
+            if k + 6 <= 10 and s[k + 6] is not None:
+                t = lz.mul_by_xi(ctx, s[k + 6])
+                a = t if a is None else lz.add(ctx, a, t)
+            out.append(lz.reduce(ctx, a))
+        return tuple(out)
+
+    def assert_equal(self, ctx: Context, a, b):
+        for x, y in zip(a, b):
+            self.fp2.assert_equal(ctx, x, y)
+
+    def assert_one(self, ctx: Context, a):
+        one = self.one(ctx)
+        self.assert_equal(ctx, a, one)
+
+    def inverse(self, ctx: Context, a) -> tuple:
+        """Witnessed inverse: load inv(a) and constrain a * inv == 1."""
+        av = self.value(a)
+        inv = self.load(ctx, av.inv())
+        prod = self.mul(ctx, a, inv)
+        self.assert_one(ctx, prod)
+        return inv
+
+    # -- exponentiation by |x| (BLS parameter), for the final exp -------
+    def pow_abs_x(self, ctx: Context, a) -> tuple:
+        """a^|x|, |x| = 0xd201000000010000 (square-and-multiply over the
+        fixed bit pattern; bits 63,62,60,57,48,16)."""
+        absx = -bls.BLS_X
+        bits = bin(absx)[2:]
+        acc = a
+        for bit in bits[1:]:
+            acc = self.square(ctx, acc)
+            if bit == "1":
+                acc = self.mul(ctx, acc, a)
+        return acc

@@ -93,6 +93,93 @@ class Fp2Chip:
         self.assert_equal(ctx, prod, one)
 
 
+class Fp2Lazy:
+    """Lazily-reduced Fq2 arithmetic: elements are (OverflowInt, OverflowInt)
+    pairs accumulated with no-carry limb ops and reduced once per output
+    coefficient (halo2-ecc's FieldExtPoint-over-CRTInteger pattern — this is
+    what makes the in-circuit pairing affordable: an Fp12 mul costs 12
+    reductions instead of 144)."""
+
+    FQ_BITS = 381  # reduced CrtUint elements are < 2^381
+
+    def __init__(self, fp2: Fp2Chip):
+        self.fp2 = fp2
+        self.big = fp2.fp.big
+
+    # -- entering the lazy domain --------------------------------------
+    def lift(self, ctx: Context, a) -> tuple:
+        """(CrtUint, CrtUint) -> (OverflowInt, OverflowInt)."""
+        return (self.big.to_overflow(a[0], self.FQ_BITS),
+                self.big.to_overflow(a[1], self.FQ_BITS))
+
+    def coeff_sum(self, ctx: Context, a):
+        """a0 + a1 as an OverflowInt (the Karatsuba operand sum) — hoist and
+        reuse when the same element multiplies many others (Fp12 mul)."""
+        big = self.big
+        return big.add_ovf(ctx, big.to_overflow(a[0], self.FQ_BITS),
+                           big.to_overflow(a[1], self.FQ_BITS))
+
+    def mul(self, ctx: Context, a, b, sa=None, sb=None) -> tuple:
+        """Reduced pairs -> lazy product (a0b0 - a1b1, a0b1 + a1b0),
+        Karatsuba: 3 limb convolutions instead of 4. sa/sb: optional
+        precomputed coeff_sum(a)/coeff_sum(b)."""
+        big = self.big
+        t0 = big.mul_ovf(ctx, a[0], b[0], self.FQ_BITS)
+        t1 = big.mul_ovf(ctx, a[1], b[1], self.FQ_BITS)
+        sa = sa if sa is not None else self.coeff_sum(ctx, a)
+        sb = sb if sb is not None else self.coeff_sum(ctx, b)
+        t01 = big.mul_ovf(ctx, sa, sb)
+        cross = big.sub_ovf(ctx, big.sub_ovf(ctx, t01, t0), t1)
+        return (big.sub_ovf(ctx, t0, t1), cross)
+
+    def mul_by_fq_cell(self, ctx: Context, a, x: "CrtUint") -> tuple:
+        """Fq2 pair times a base-field CrtUint cell."""
+        big = self.big
+        return (big.mul_ovf(ctx, a[0], x, self.FQ_BITS),
+                big.mul_ovf(ctx, a[1], x, self.FQ_BITS))
+
+    # -- lazy-domain ops ------------------------------------------------
+    def add(self, ctx: Context, x, y) -> tuple:
+        big = self.big
+        return (big.add_ovf(ctx, x[0], y[0]), big.add_ovf(ctx, x[1], y[1]))
+
+    def sub(self, ctx: Context, x, y) -> tuple:
+        big = self.big
+        return (big.sub_ovf(ctx, x[0], y[0]), big.sub_ovf(ctx, x[1], y[1]))
+
+    def mul_const(self, ctx: Context, a, k: "bls.Fq2") -> tuple:
+        """REDUCED pair times an Fq2 host constant (k0 + k1 u), via
+        constant-limb convolutions: (a0k0 - a1k1, a0k1 + a1k0) lazy."""
+        big = self.big
+        k0, k1 = int(k.c[0]) % P, int(k.c[1]) % P
+        a0k0 = big.mul_ovf_const(ctx, a[0], k0, self.FQ_BITS)
+        a1k1 = big.mul_ovf_const(ctx, a[1], k1, self.FQ_BITS)
+        a0k1 = big.mul_ovf_const(ctx, a[0], k1, self.FQ_BITS)
+        a1k0 = big.mul_ovf_const(ctx, a[1], k0, self.FQ_BITS)
+        return (big.sub_ovf(ctx, a0k0, a1k1), big.add_ovf(ctx, a0k1, a1k0))
+
+    def mul_by_xi(self, ctx: Context, x) -> tuple:
+        """Times xi = 1 + u: (c0 - c1, c0 + c1)."""
+        big = self.big
+        return (big.sub_ovf(ctx, x[0], x[1]), big.add_ovf(ctx, x[0], x[1]))
+
+    def neg(self, ctx: Context, x) -> tuple:
+        from .bigint import OverflowInt
+        gate = self.fp2.fp.gate
+
+        def n(v):
+            return OverflowInt([gate.neg(ctx, l) for l in v.limbs],
+                               -v.value, v.limb_abs, v.val_abs)
+
+        return (n(x[0]), n(x[1]))
+
+    def reduce(self, ctx: Context, x) -> tuple:
+        """Lazy pair -> reduced (CrtUint, CrtUint) mod p."""
+        big = self.big
+        return (big.carry_mod_ovf(ctx, x[0], P),
+                big.carry_mod_ovf(ctx, x[1], P))
+
+
 class G2Chip:
     """Non-native G2 affine arithmetic over Fp2Chip (reference: halo2-ecc
     `EccChip<Fp2>` — the signature-side group of `assign_signature:279`)."""

@@ -17,26 +17,25 @@ def _v(x) -> int:
     return x.value if isinstance(x, AssignedValue) else int(x) % R
 
 
+def _src(x, xv):
+    """Copy source for an operand: the cell itself, or its value as a
+    constant pin."""
+    return x if x.__class__ is AssignedValue else xv
+
+
 class GateChip:
     # -- basic ops ------------------------------------------------------
     def add(self, ctx: Context, a, b) -> AssignedValue:
         """out = a + b  via  [a, b, 1, out]."""
         av, bv = _v(a), _v(b)
-        cells = ctx.gate_unit([av, bv, 1, (av + bv) % R],
-                              [a if isinstance(a, AssignedValue) else ("const", av),
-                               b if isinstance(b, AssignedValue) else ("const", bv),
-                               ("const", 1), None])
-        return cells[3]
+        return ctx.gate_unit_out(av, bv, 1, (av + bv) % R,
+                                 _src(a, av), _src(b, bv), 1, None, 3)
 
     def sub(self, ctx: Context, a, b) -> AssignedValue:
         """out = a - b  via  [out, b, 1, a]."""
         av, bv = _v(a), _v(b)
-        cells = ctx.gate_unit([(av - bv) % R, bv, 1, av],
-                              [None,
-                               b if isinstance(b, AssignedValue) else ("const", bv),
-                               ("const", 1),
-                               a if isinstance(a, AssignedValue) else ("const", av)])
-        return cells[0]
+        return ctx.gate_unit_out((av - bv) % R, bv, 1, av,
+                                 None, _src(b, bv), 1, _src(a, av), 0)
 
     def neg(self, ctx: Context, a) -> AssignedValue:
         return self.sub(ctx, 0, a)
@@ -44,32 +43,21 @@ class GateChip:
     def mul(self, ctx: Context, a, b) -> AssignedValue:
         """out = a * b  via  [0, a, b, out]."""
         av, bv = _v(a), _v(b)
-        cells = ctx.gate_unit([0, av, bv, av * bv % R],
-                              [("const", 0),
-                               a if isinstance(a, AssignedValue) else ("const", av),
-                               b if isinstance(b, AssignedValue) else ("const", bv),
-                               None])
-        return cells[3]
+        return ctx.gate_unit_out(0, av, bv, av * bv % R,
+                                 0, _src(a, av), _src(b, bv), None, 3)
 
     def mul_add(self, ctx: Context, a, b, c) -> AssignedValue:
         """out = a * b + c  via  [c, a, b, out]."""
         av, bv, cv = _v(a), _v(b), _v(c)
-        cells = ctx.gate_unit([cv, av, bv, (cv + av * bv) % R],
-                              [c if isinstance(c, AssignedValue) else ("const", cv),
-                               a if isinstance(a, AssignedValue) else ("const", av),
-                               b if isinstance(b, AssignedValue) else ("const", bv),
-                               None])
-        return cells[3]
+        return ctx.gate_unit_out(cv, av, bv, (cv + av * bv) % R,
+                                 _src(c, cv), _src(a, av), _src(b, bv), None, 3)
 
     def div_unsafe(self, ctx: Context, a, b) -> AssignedValue:
         """out = a / b (b must be nonzero; only the product is constrained)."""
         av, bv = _v(a), _v(b)
         q = av * pow(bv, -1, R) % R
-        cells = ctx.gate_unit([0, q, bv, av],
-                              [("const", 0), None,
-                               b if isinstance(b, AssignedValue) else ("const", bv),
-                               a if isinstance(a, AssignedValue) else ("const", av)])
-        return cells[1]
+        return ctx.gate_unit_out(0, q, bv, av,
+                                 0, None, _src(b, bv), _src(a, av), 1)
 
     # -- boolean -------------------------------------------------------
     def assert_bit(self, ctx: Context, a: AssignedValue):

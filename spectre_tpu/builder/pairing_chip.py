@@ -1,0 +1,177 @@
+"""In-circuit BLS12-381 pairing: multi-Miller loop + final exponentiation.
+
+Reference parity: halo2-ecc `PairingChip` / `BlsSignatureChip`
+(`sync_step_circuit.rs:171` `assert_valid_signature` — the single largest
+constraint block of the reference StepCircuit, SURVEY.md §3.3 step 5).
+
+Design notes (TPU-first means constraint-count-first here):
+- Affine Miller loop over the TWISTED coordinates with witnessed slopes
+  (div_unsafe + the chord/tangent constraint); untwisting is folded into the
+  line's w-slot placement: l = xi*y_P + (lam*x_T - y_T) w^3 - lam*x_P w^5
+  (the xi scaling lies in Fq2 (a subfield), killed by the final
+  exponentiation, so it is sound to fold).
+- Lines are 3-sparse in the w-basis -> `Fp12Chip.mul_sparse_035` (18 Fq2
+  products instead of 36).
+- Final exponentiation: easy part (conj/inv, frobenius^2) then the hard part
+  via the BLS12 chain for the 3x exponent identity
+      3*(p^4 - p^2 + 1)/r = 3 + (x-1)^2 (x+p) (x^2 + p^2 - 1)
+  (host-validated in tests; the 3x multiple is sound for an ==1 check since
+  cubing is a bijection on the order-r roots of unity).
+- Signature soundness: adds a psi-endomorphism G2 subgroup check
+  (psi(Q) == [x]Q) on the assigned signature so low-order points cannot hit
+  the T == +-Q degenerate chord cases mid-loop.
+"""
+
+from __future__ import annotations
+
+from ..fields import bls12_381 as bls
+from .context import Context
+from .fp2_chip import Fp2Chip, G2Chip
+from .fp12_chip import Fp12Chip
+
+P = bls.P
+ABS_X_BITS = bin(-bls.BLS_X)[2:]   # |x| = 0xd201000000010000, MSB first
+
+
+class PairingChip:
+    def __init__(self, fp12: Fp12Chip):
+        self.fp12 = fp12
+        self.fp2 = fp12.fp2
+        self.lz = fp12.lazy
+        self.g2 = G2Chip(self.fp2)
+
+    # -- line construction ---------------------------------------------
+    def _line(self, ctx: Context, lam, t_pt, p_pt) -> tuple:
+        """Sparse line coefficients (c0, c3, c5) for the line of slope lam
+        through T (twisted coords), evaluated at P = (x_p, y_p) in G1."""
+        lz = self.lz
+        x_t, y_t = t_pt
+        x_p, y_p = p_pt
+        c0 = (y_p, y_p)                               # xi * y_P = y_P(1 + u)
+        c3 = lz.reduce(ctx, lz.sub(ctx, lz.mul(ctx, lam, x_t),
+                                   lz.lift(ctx, y_t)))
+        c5 = lz.reduce(ctx, lz.neg(ctx, lz.mul_by_fq_cell(ctx, lam, x_p)))
+        return c0, c3, c5
+
+    def _double_step(self, ctx: Context, t_pt) -> tuple:
+        """(2T, tangent slope): lam * 2y = 3x^2; lazy point formulas."""
+        fp2, lz = self.fp2, self.lz
+        x, y = t_pt
+        x2 = fp2.square(ctx, x)
+        lam = fp2.div_unsafe(ctx, fp2.mul_scalar(ctx, x2, 3),
+                             fp2.mul_scalar(ctx, y, 2))
+        lam2 = lz.mul(ctx, lam, lam)
+        x3 = lz.reduce(ctx, lz.sub(ctx, lz.sub(ctx, lam2, lz.lift(ctx, x)),
+                                   lz.lift(ctx, x)))
+        xd = fp2.sub(ctx, x, x3)
+        y3 = lz.reduce(ctx, lz.sub(ctx, lz.mul(ctx, lam, xd),
+                                   lz.lift(ctx, y)))
+        return (x3, y3), lam
+
+    def _add_step(self, ctx: Context, t_pt, q_pt) -> tuple:
+        """(T+Q, chord slope), strict (x_T != x_Q constrained)."""
+        fp2, lz = self.fp2, self.lz
+        xt, yt = t_pt
+        xq, yq = q_pt
+        dx = fp2.sub(ctx, xt, xq)
+        fp2.assert_nonzero(ctx, dx)
+        dy = fp2.sub(ctx, yt, yq)
+        lam = fp2.div_unsafe(ctx, dy, dx)
+        lam2 = lz.mul(ctx, lam, lam)
+        x3 = lz.reduce(ctx, lz.sub(ctx, lz.sub(ctx, lam2, lz.lift(ctx, xt)),
+                                   lz.lift(ctx, xq)))
+        xd = fp2.sub(ctx, xt, x3)
+        y3 = lz.reduce(ctx, lz.sub(ctx, lz.mul(ctx, lam, xd),
+                                   lz.lift(ctx, yt)))
+        return (x3, y3), lam
+
+    def _sparse_to_fp12(self, ctx: Context, c0, c3, c5) -> tuple:
+        zero = self.fp2.load_constant(ctx, (0, 0))
+        return (c0, zero, zero, c3, zero, c5)
+
+    # -- Miller loop ----------------------------------------------------
+    def multi_miller_loop(self, ctx: Context, pairs) -> tuple:
+        """pairs: [(P, Q)] with P = (x, y) G1 CrtUints (from
+        EccChip.load_point) and Q a G2 point (from G2Chip.load_point).
+        Returns f (Fp12 element, conjugated for the negative x)."""
+        fp12 = self.fp12
+        ts = [q for (_p, q) in pairs]
+        f = None
+        for bit in ABS_X_BITS[1:]:
+            if f is not None:
+                f = fp12.square(ctx, f)
+            for i, (p_pt, q_pt) in enumerate(pairs):
+                t2, lam = self._double_step(ctx, ts[i])
+                c0, c3, c5 = self._line(ctx, lam, ts[i], p_pt)
+                if f is None:
+                    f = self._sparse_to_fp12(ctx, c0, c3, c5)
+                else:
+                    f = fp12.mul_sparse_035(ctx, f, c0, c3, c5)
+                ts[i] = t2
+            if bit == "1":
+                for i, (p_pt, q_pt) in enumerate(pairs):
+                    t2, lam = self._add_step(ctx, ts[i], q_pt)
+                    c0, c3, c5 = self._line(ctx, lam, ts[i], p_pt)
+                    f = fp12.mul_sparse_035(ctx, f, c0, c3, c5)
+                    ts[i] = t2
+        # x < 0: f_{x} ~ conj(f_{|x|}) up to final-exp-killed factors
+        return fp12.conjugate(ctx, f)
+
+    # -- final exponentiation ------------------------------------------
+    def final_exponentiation(self, ctx: Context, f) -> tuple:
+        fp12 = self.fp12
+        # easy: f^((p^6-1)(p^2+1))
+        t = fp12.mul(ctx, fp12.conjugate(ctx, f), fp12.inverse(ctx, f))
+        t = fp12.mul(ctx, fp12.frobenius(ctx, t, 2), t)
+
+        # hard (3x multiple): 3 + (x-1)^2 (x+p) (x^2+p^2-1); t is now
+        # cyclotomic so inverse == conjugate and x<0 folds into conjugates
+        def pow_x_minus_1(u):
+            # u^(x-1) = conj(u^|x| * u)
+            return fp12.conjugate(ctx, fp12.mul(ctx, fp12.pow_abs_x(ctx, u), u))
+
+        a = pow_x_minus_1(t)
+        a = pow_x_minus_1(a)
+        b = fp12.mul(ctx, fp12.conjugate(ctx, fp12.pow_abs_x(ctx, a)),
+                     fp12.frobenius(ctx, a, 1))
+        bx2 = fp12.pow_abs_x(ctx, fp12.pow_abs_x(ctx, b))
+        c2 = fp12.mul(ctx, fp12.mul(ctx, bx2, fp12.frobenius(ctx, b, 2)),
+                      fp12.conjugate(ctx, b))
+        t3 = fp12.mul(ctx, fp12.square(ctx, t), t)
+        return fp12.mul(ctx, c2, t3)
+
+    def assert_pairing_product_one(self, ctx: Context, pairs):
+        """Constrain prod e(P_i, Q_i) == 1 (the BLS verification shape:
+        e(pk, H(m)) * e(-g1, sig) == 1)."""
+        f = self.multi_miller_loop(ctx, pairs)
+        res = self.final_exponentiation(ctx, f)
+        self.fp12.assert_one(ctx, res)
+
+    # -- psi endomorphism + subgroup check ------------------------------
+    def g2_psi(self, ctx: Context, q_pt) -> tuple:
+        cx, cy = bls.psi_constants()
+        fp2, lz = self.fp2, self.lz
+        x, y = q_pt
+        px = lz.reduce(ctx, lz.mul_const(ctx, fp2.conjugate(ctx, x), cx))
+        py = lz.reduce(ctx, lz.mul_const(ctx, fp2.conjugate(ctx, y), cy))
+        return (px, py)
+
+    def g2_scalar_mul_abs_x(self, ctx: Context, q_pt) -> tuple:
+        """[|x|] Q by double-and-add (strict adds; Q of prime order r never
+        hits T == +-Q for the partial constants of |x| < r)."""
+        t = q_pt
+        for bit in ABS_X_BITS[1:]:
+            t = self.g2.double(ctx, t)
+            if bit == "1":
+                t = self.g2.add_unequal(ctx, t, q_pt, strict=True)
+        return t
+
+    def assert_g2_subgroup(self, ctx: Context, q_pt):
+        """psi(Q) == [x]Q = -[|x|]Q — rejects points outside the r-order
+        subgroup (soundness guard for the Miller loop's strict chords)."""
+        fp2 = self.fp2
+        psi_q = self.g2_psi(ctx, q_pt)
+        t = self.g2_scalar_mul_abs_x(ctx, q_pt)
+        neg_y = fp2.neg(ctx, t[1])
+        fp2.assert_equal(ctx, psi_q[0], t[0])
+        fp2.assert_equal(ctx, psi_q[1], neg_y)

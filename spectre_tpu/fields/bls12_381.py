@@ -430,6 +430,76 @@ def iso3_map(pt):
 _ISO3_C = Fq2([0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38E, 0])
 
 
+# ---------------------------------------------------------------------------
+# psi endomorphism on E'(Fq2): untwist -> p-Frobenius -> twist, in constant
+# form psi(x, y) = (cx * conj(x), cy * conj(y)). Used for fast cofactor
+# clearing (Budroni–Pintore) and G2 subgroup checks (psi(Q) == [x]Q), both
+# host-side and as the oracle for the in-circuit pairing chips.
+# ---------------------------------------------------------------------------
+
+def _fq2_conj(a: "Fq2") -> "Fq2":
+    return Fq2([a.c[0], (-a.c[1]) % P])
+
+
+@functools.cache
+def psi_constants():
+    """(cx, cy) with psi(x,y) = (cx*conj(x), cy*conj(y)); derived by pushing
+    a sample point through twist -> Frobenius -> untwist and verified on an
+    independent point."""
+    W2 = Fq12([0, 0, 1] + [0] * 9)
+    W3 = Fq12([0, 0, 0, 1] + [0] * 8)
+
+    def raw_psi(pt):
+        x, y = twist(pt)
+        fx, fy = x ** P, y ** P
+
+        def to_fq2(v):
+            c = v.c
+            assert all(ci == 0 for i, ci in enumerate(c) if i not in (0, 6))
+            return Fq2([(c[0] + c[6]) % P, c[6]])
+
+        return (to_fq2(fx * W2), to_fq2(fy * W3))
+
+    q1 = g2_curve.mul(G2_GEN, 123)
+    px, py = raw_psi(q1)
+    cx = px / _fq2_conj(q1[0])
+    cy = py / _fq2_conj(q1[1])
+    q2 = g2_curve.mul(G2_GEN, 987654321987654321)
+    assert raw_psi(q2) == (cx * _fq2_conj(q2[0]), cy * _fq2_conj(q2[1]))
+    return cx, cy
+
+
+def g2_psi(pt):
+    if pt is None:
+        return None
+    cx, cy = psi_constants()
+    return (cx * _fq2_conj(pt[0]), cy * _fq2_conj(pt[1]))
+
+
+def g2_smul(pt, k: int):
+    """Scalar mul with signed k (no subgroup assumption)."""
+    if k < 0:
+        r = g2_curve.mul_unsafe(pt, -k)
+        return None if r is None else g2_curve.neg(r)
+    return g2_curve.mul_unsafe(pt, k)
+
+
+def g2_in_subgroup_psi(pt) -> bool:
+    """Q in G2 iff psi(Q) == [x]Q (endomorphism eigenvalue check)."""
+    if pt is None:
+        return True
+    return g2_psi(pt) == g2_smul(pt, BLS_X)
+
+
+def clear_cofactor_g2_bp(pt):
+    """Budroni–Pintore: [x^2-x-1]Q + [x-1]psi(Q) + psi^2(2Q). Equal to
+    H_EFF_G2 * Q for every curve point (asserted in tests)."""
+    a = g2_smul(pt, BLS_X * BLS_X - BLS_X - 1)
+    b = g2_smul(g2_psi(pt), BLS_X - 1)
+    c = g2_psi(g2_psi(g2_smul(pt, 2)))
+    return g2_curve.add(g2_curve.add(a, b), c)
+
+
 # h_eff for the G2 suite (RFC 9380 §8.8.2): the scalar equivalent of the
 # Budroni–Pintore endomorphism-accelerated clearing. NOT equal to the plain
 # cofactor H2 — outputs differ by a unit mod r, so interop REQUIRES h_eff.

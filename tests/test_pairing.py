@@ -1,0 +1,151 @@
+"""In-circuit Fp12 tower + pairing chip tests.
+
+Default tier: component correctness vs the host field oracle (values AND
+mock-proved constraints at small scale). RUN_SLOW tier: the full two-pair
+BLS verification shape (27M cells — witness-level assert + forged-signature
+rejection; reference parity: `sync_step_circuit.rs:171`)."""
+
+import os
+import secrets
+
+import pytest
+
+from spectre_tpu.builder import Context, RangeChip
+from spectre_tpu.builder.fp_chip import EccChip, FpChip
+from spectre_tpu.builder.fp2_chip import Fp2Chip, G2Chip
+from spectre_tpu.builder.fp12_chip import (Fp12Chip, fq12_to_tower,
+                                           tower_to_fq12)
+from spectre_tpu.builder.pairing_chip import PairingChip
+from spectre_tpu.fields import bls12_381 as bls
+from spectre_tpu.plonk.mock import mock_prove
+
+RUN_SLOW = os.environ.get("RUN_SLOW") == "1"
+
+
+def _chips():
+    ctx = Context()
+    fp = FpChip(RangeChip(lookup_bits=8))
+    fp2 = Fp2Chip(fp)
+    fp12 = Fp12Chip(fp2)
+    return ctx, fp, fp2, fp12
+
+
+def _mock(ctx, k=14):
+    cfg = ctx.auto_config(k=k, lookup_bits=8)
+    assert mock_prove(cfg, ctx.assignment(cfg))
+
+
+def _rand_fq12():
+    return bls.Fq12([secrets.randbelow(bls.P) for _ in range(12)])
+
+
+class TestFp12Chip:
+    def test_tower_conversion_roundtrip(self):
+        x = _rand_fq12()
+        assert tower_to_fq12(fq12_to_tower(x)) == x
+
+    def test_mul_square_vs_host(self):
+        ctx, fp, fp2, fp12 = _chips()
+        x, y = _rand_fq12(), _rand_fq12()
+        a, b = fp12.load(ctx, x), fp12.load(ctx, y)
+        assert fp12.value(fp12.mul(ctx, a, b)) == x * y
+        assert fp12.value(fp12.square(ctx, a)) == x * x
+        _mock(ctx, k=14)
+
+    def test_frobenius_conjugate_inverse_vs_host(self):
+        ctx, fp, fp2, fp12 = _chips()
+        x = _rand_fq12()
+        a = fp12.load(ctx, x)
+        assert fp12.value(fp12.frobenius(ctx, a, 1)) == x ** bls.P
+        assert fp12.value(fp12.frobenius(ctx, a, 2)) == x ** (bls.P ** 2)
+        assert fp12.value(fp12.conjugate(ctx, a)) == x ** (bls.P ** 6)
+        assert fp12.value(fp12.inverse(ctx, a)) == x.inv()
+        _mock(ctx, k=14)
+
+    def test_sparse_mul_matches_full(self):
+        ctx, fp, fp2, fp12 = _chips()
+        x = _rand_fq12()
+        a = fp12.load(ctx, x)
+        c0 = fp2.load(ctx, bls.Fq2([3, 5]))
+        c3 = fp2.load(ctx, bls.Fq2([7, 11]))
+        c5 = fp2.load(ctx, bls.Fq2([13, 17]))
+        sparse = fp12.mul_sparse_035(ctx, a, c0, c3, c5)
+        line = fp12.load_constant(
+            ctx, [bls.Fq2([3, 5]), bls.Fq2([0, 0]), bls.Fq2([0, 0]),
+                  bls.Fq2([7, 11]), bls.Fq2([0, 0]), bls.Fq2([13, 17])])
+        full = fp12.mul(ctx, a, line)
+        assert fp12.value(sparse) == fp12.value(full)
+        _mock(ctx, k=14)
+
+
+class TestPairingComponents:
+    def test_double_add_steps_vs_host(self):
+        ctx, fp, fp2, fp12 = _chips()
+        chip = PairingChip(fp12)
+        g2 = G2Chip(fp2)
+        q1 = bls.g2_curve.mul(bls.G2_GEN, 5)
+        q2 = bls.g2_curve.mul(bls.G2_GEN, 9)
+        c1, c2 = g2.load_point(ctx, q1), g2.load_point(ctx, q2)
+        d, _lam = chip._double_step(ctx, c1)
+        want = bls.g2_curve.double(q1)
+        assert (fp2.value(d[0]), fp2.value(d[1])) == want
+        s, _lam = chip._add_step(ctx, c1, c2)
+        want = bls.g2_curve.add(q1, q2)
+        assert (fp2.value(s[0]), fp2.value(s[1])) == want
+        _mock(ctx, k=14)
+
+    def test_psi_vs_host(self):
+        ctx, fp, fp2, fp12 = _chips()
+        chip = PairingChip(fp12)
+        g2 = G2Chip(fp2)
+        q = bls.g2_curve.mul(bls.G2_GEN, 31337)
+        qc = g2.load_point(ctx, q)
+        p = chip.g2_psi(ctx, qc)
+        want = bls.g2_psi(q)
+        assert (fp2.value(p[0]), fp2.value(p[1])) == want
+        _mock(ctx, k=13)
+
+    def test_final_exp_chain_host_identity(self):
+        # the 3x hard-part chain the circuit implements, validated on host
+        P, R, X = bls.P, bls.R, bls.BLS_X
+        f = _rand_fq12()
+        t = (f ** (P ** 6 - 1)) ** (P ** 2 + 1)
+        conj = lambda u: u ** (P ** 6)
+        pax = lambda u: u ** (-X)
+        pxm1 = lambda u: conj(pax(u) * u)
+        a = pxm1(pxm1(t))
+        b = conj(pax(a)) * (a ** P)
+        res = pax(pax(b)) * (b ** (P ** 2)) * conj(b) * t * t * t
+        assert res == t ** (3 * ((P ** 4 - P ** 2 + 1) // R))
+        assert conj(t) == t.inv()
+
+
+@pytest.mark.skipif(not RUN_SLOW, reason="27M-cell pairing (set RUN_SLOW=1)")
+class TestFullPairing:
+    def test_bls_verification_shape(self):
+        sk = 0x1234567
+        pk = bls.sk_to_pk(sk)
+        h = bls.hash_to_g2(b"full pairing test")
+        sig = bls.g2_curve.mul(h, sk)
+        ctx, fp, fp2, fp12 = _chips()
+        chip = PairingChip(fp12)
+        ecc, g2 = EccChip(fp), G2Chip(fp2)
+        sig_c = g2.load_point(ctx, sig)
+        chip.assert_g2_subgroup(ctx, sig_c)
+        chip.assert_pairing_product_one(ctx, [
+            (ecc.load_point(ctx, pk), g2.load_point(ctx, h)),
+            (ecc.load_point(ctx, bls.g1_curve.neg(bls.G1_GEN)), sig_c)])
+
+    def test_forged_signature_rejected(self):
+        sk = 0x1234567
+        pk = bls.sk_to_pk(sk)
+        h = bls.hash_to_g2(b"full pairing test")
+        bad = bls.g2_curve.mul(h, sk + 1)
+        ctx, fp, fp2, fp12 = _chips()
+        chip = PairingChip(fp12)
+        ecc, g2 = EccChip(fp), G2Chip(fp2)
+        with pytest.raises(AssertionError):
+            chip.assert_pairing_product_one(ctx, [
+                (ecc.load_point(ctx, pk), g2.load_point(ctx, h)),
+                (ecc.load_point(ctx, bls.g1_curve.neg(bls.G1_GEN)),
+                 g2.load_point(ctx, bad))])
