@@ -94,6 +94,12 @@ class Fp12Chip:
                 t = lz.mul(ctx, a[i], b[j], sa=sums_a[i], sb=sums_b[j])
                 k = i + j
                 s[k] = t if s[k] is None else lz.add(ctx, s[k], t)
+        return self._fold_and_reduce(ctx, s)
+
+    def _fold_and_reduce(self, ctx: Context, s: list) -> tuple:
+        """Slot sums s[0..10] -> 6 reduced tower coefficients:
+        c_k = reduce(s_k + xi * s_{k+6})."""
+        lz = self.lazy
         out = []
         for k in range(6):
             acc = s[k]
@@ -116,13 +122,7 @@ class Fp12Chip:
                     t = (big.scale_ovf(ctx, t[0], 2), big.scale_ovf(ctx, t[1], 2))
                 k = i + j
                 s[k] = t if s[k] is None else lz.add(ctx, s[k], t)
-        out = []
-        for k in range(6):
-            acc = s[k]
-            if k + 6 <= 10 and s[k + 6] is not None:
-                acc = lz.add(ctx, acc, lz.mul_by_xi(ctx, s[k + 6]))
-            out.append(lz.reduce(ctx, acc))
-        return tuple(out)
+        return self._fold_and_reduce(ctx, s)
 
     def conjugate(self, ctx: Context, a) -> tuple:
         """f^(p^6): w -> -w (gamma6 = -1): negate odd slots."""
@@ -167,14 +167,7 @@ class Fp12Chip:
             acc(i, lz.mul(ctx, fi, c0, sa=sfi, sb=sum_c0))
             acc(i + 3, lz.mul(ctx, fi, c3, sa=sfi, sb=sum_c3))
             acc(i + 5, lz.mul(ctx, fi, c5, sa=sfi, sb=sum_c5))
-        out = []
-        for k in range(6):
-            a = s[k]
-            if k + 6 <= 10 and s[k + 6] is not None:
-                t = lz.mul_by_xi(ctx, s[k + 6])
-                a = t if a is None else lz.add(ctx, a, t)
-            out.append(lz.reduce(ctx, a))
-        return tuple(out)
+        return self._fold_and_reduce(ctx, s)
 
     def assert_equal(self, ctx: Context, a, b):
         for x, y in zip(a, b):

@@ -82,6 +82,10 @@ class Fp2Chip:
         self.fp.assert_equal(ctx, self.fp._reduced(ctx, a[1]),
                              self.fp._reduced(ctx, b[1]))
 
+    def select(self, ctx: Context, bit, a, b) -> tuple:
+        return (self.fp.select(ctx, bit, a[0], b[0]),
+                self.fp.select(ctx, bit, a[1], b[1]))
+
     def assert_nonzero(self, ctx: Context, a):
         """Constrain a != 0 in Fp2 via witnessed inverse a*inv == 1 (same
         soundness argument as FpChip.assert_nonzero)."""

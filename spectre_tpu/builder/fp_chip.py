@@ -81,6 +81,25 @@ class FpChip:
         padded = a.limbs + [zero] * (2 * len(a.limbs) - 1 - len(a.limbs))
         return self.big.carry_mod(ctx, padded, a.value, P)
 
+    def from_limbs(self, ctx: Context, limbs: list, value: int) -> CrtUint:
+        """CrtUint from existing (range-checked) limb cells."""
+        native = self.gate.inner_product_const(
+            ctx, limbs, self.big._pow_native[:len(limbs)])
+        return CrtUint(limbs, native, value)
+
+    def select(self, ctx: Context, bit, a: CrtUint, b: CrtUint) -> CrtUint:
+        """bit ? a : b — limbs and the already-constrained natives both
+        selected directly (no native rebuild)."""
+        gate = self.gate
+        limbs = [gate.select(ctx, x, y, bit) for x, y in zip(a.limbs, b.limbs)]
+        native = gate.select(ctx, a.native, b.native, bit)
+        return CrtUint(limbs, native, a.value if bit.value else b.value)
+
+    def load_constant_point(self, ctx: Context, pt) -> tuple:
+        """Constant G1 point as CrtUint pair (no on-curve check needed)."""
+        return (self.load_constant(ctx, int(pt[0])),
+                self.load_constant(ctx, int(pt[1])))
+
     def assert_nonzero(self, ctx: Context, a: CrtUint):
         """Constrain a != 0 (mod p) via a witnessed inverse: a*inv - 1 == 0
         (mod p). Sound without canonical form — no inverse of 0 exists, so no

@@ -1,0 +1,380 @@
+"""In-circuit hash-to-G2: BLS12381G2_XMD:SHA-256_SSWU_RO.
+
+Reference parity: the halo2-lib fork's `HashToCurveChip` (SSWU +
+ExpandMsgXmd; `sync_step_circuit.rs:165-169`) — the reason the reference
+forks halo2-lib at all (SURVEY.md L0).
+
+Pipeline (mirrors fields/bls12_381.py's host implementation, which is
+blst-fixture-validated):
+  expand_message_xmd (SHA chip; the all-constant z_pad block is folded into
+  a precomputed constant state) -> hash_to_field (nibble recomposition into
+  104-bit limbs + one lazy reduction per component) -> simplified SWU on E2'
+  with SOUND branch selection (w^2 == gx1 * sel pins is_square(gx1); sel
+  selects {1, Z} by the e1 bit and Z is a non-residue) -> Velu-derived
+  3-isogeny -> strict point add -> Budroni-Pintore cofactor clearing
+  (psi-endomorphism ladder; host-validated equal to the H_EFF scalar).
+
+sgn0 uses canonicalized coordinates (enforce_lt p) so parity is
+well-defined; the witnessed y is pinned by y^2 == g(x) AND
+sgn0(y) == sgn0(u).
+"""
+
+from __future__ import annotations
+
+from ..fields import bls12_381 as bls, bn254
+from .bigint import BASE, LIMB_BITS, NUM_LIMBS, CrtUint, OverflowInt
+from .context import AssignedValue, Context
+from .pairing_chip import PairingChip
+from .sha256_chip import Sha256Chip, XOR_OP
+
+P = bls.P
+R = bn254.R
+
+
+class HashToCurveChip:
+    def __init__(self, pairing: PairingChip, sha: Sha256Chip):
+        self.pairing = pairing
+        self.fp2 = pairing.fp2
+        self.fp = self.fp2.fp
+        self.lz = pairing.lz
+        self.g2 = pairing.g2
+        self.sha = sha
+
+    # ------------------------------------------------------------------
+    # expand_message_xmd
+    # ------------------------------------------------------------------
+    def expand_message_xmd(self, ctx: Context, msg_bytes: list,
+                           dst: bytes, len_in_bytes: int) -> list:
+        """msg_bytes: 8-bit-checked byte cells. Returns len_in_bytes//32
+        digests (lists of 8 Words)."""
+        sha = self.sha
+        assert len(dst) <= 255
+        ell = (len_in_bytes + 31) // 32
+        assert ell <= 255 and len_in_bytes % 32 == 0
+        dst_prime = dst + bytes([len(dst)])
+        lib = len_in_bytes.to_bytes(2, "big")
+
+        # b0 = H(Z_pad(64) || msg || lib || 0x00 || dst'); the z_pad block is
+        # constant, so start from its precomputed state
+        state = [sha.constant_word(ctx, w) for w in _STATE_AFTER_ZERO_BLOCK]
+        tail = [("v", c) for c in msg_bytes]
+        tail += [("c", b) for b in lib + b"\x00" + dst_prime]
+        b0 = self._digest_tail(ctx, state, tail,
+                               total_len=64 + len(msg_bytes) + 3 + len(dst_prime))
+
+        outs = []
+        prev = None
+        for i in range(1, ell + 1):
+            if i == 1:
+                first8 = b0
+            else:
+                first8 = []          # b0 XOR b_{i-1}, nibble-wise
+                for w0, wp in zip(b0, prev):
+                    nibs = sha._nib_op(ctx, XOR_OP, w0.nibs, wp.nibs)
+                    first8.append(sha._recompose(ctx, nibs))
+            tail = [("w", w) for w in first8]
+            tail += [("c", b) for b in bytes([i]) + dst_prime]
+            prev = self._digest_tail(ctx, sha.initial_state(ctx), tail,
+                                     total_len=32 + 1 + len(dst_prime))
+            outs.append(prev)
+        return outs
+
+    def _digest_tail(self, ctx: Context, state: list, items: list,
+                     total_len: int) -> list:
+        """SHA-compress a tail of items (('v', byte cell) | ('c', const
+        byte) | ('w', Word)) onto state, with padding for a total message of
+        total_len bytes (bytes already folded into `state` included)."""
+        sha = self.sha
+        stream = list(items)
+        blen = sum(4 if k == "w" else 1 for k, _ in stream) + 1
+        stream.append(("c", 0x80))
+        while (blen % 64) != 56:
+            stream.append(("c", 0))
+            blen += 1
+        stream += [("c", b) for b in (8 * total_len).to_bytes(8, "big")]
+
+        words, buf = [], []
+        for kind, v in stream:
+            if kind == "w":
+                assert not buf, "Word not 4-byte aligned in digest tail"
+                words.append(v)
+                continue
+            buf.append((kind, v))
+            if len(buf) == 4:
+                if all(k == "c" for k, _ in buf):
+                    words.append(sha.constant_word(
+                        ctx, int.from_bytes(bytes(b for _, b in buf), "big")))
+                else:
+                    cells = [c if k == "v" else ctx.load_constant(c)
+                             for k, c in buf]
+                    words.append(sha.word_from_bytes_be(ctx, cells))
+                buf = []
+        assert not buf and len(words) % 16 == 0
+        for off in range(0, len(words), 16):
+            state = sha.compress(ctx, state, words[off:off + 16])
+        return state
+
+    # ------------------------------------------------------------------
+    # hash_to_field
+    # ------------------------------------------------------------------
+    def _digests_to_fq(self, ctx: Context, d1: list, d2: list) -> CrtUint:
+        """Two 8-Word digests = one 64-byte BE integer -> reduced mod p.
+        Words carry LSB-first nibbles; ascending 4-bit positions of the BE
+        value are word 15..0, nibble 0..7."""
+        nibs = []
+        for w in reversed(d1 + d2):
+            nibs.extend(w.nibs)
+        assert len(nibs) == 128
+        per_limb = LIMB_BITS // 4            # 26 nibbles per 104-bit limb
+        val = sum(n.value << (4 * i) for i, n in enumerate(nibs))
+        limbs = []
+        for j in range(NUM_LIMBS):
+            chunk = nibs[j * per_limb:(j + 1) * per_limb]
+            if not chunk:
+                break
+            limbs.append(self.fp.gate.inner_product_const(
+                ctx, chunk, [1 << (4 * i) for i in range(len(chunk))]))
+        x = OverflowInt(limbs, val, BASE - 1, 1 << 512)
+        return self.fp.big.carry_mod_ovf(ctx, x, P)
+
+    def hash_to_field_fq2(self, ctx: Context, msg_bytes: list,
+                          dst: bytes, count: int = 2) -> list:
+        digests = self.expand_message_xmd(ctx, msg_bytes, dst, count * 128)
+        return [(self._digests_to_fq(ctx, digests[4 * i], digests[4 * i + 1]),
+                 self._digests_to_fq(ctx, digests[4 * i + 2], digests[4 * i + 3]))
+                for i in range(count)]
+
+    # ------------------------------------------------------------------
+    # selects / zero assertions over Fq2 pairs
+    # ------------------------------------------------------------------
+    def _select_const_fq2(self, ctx: Context, bit, a_const, b_const) -> tuple:
+        """bit ? a_const : b_const (host Fq2 constants) as a reduced pair:
+        limb = b + bit*(a-b), affine in the boolean bit."""
+        gate = self.fp.gate
+        out = []
+        for comp in range(2):
+            av, bv = int(a_const.c[comp]), int(b_const.c[comp])
+            limbs = []
+            for i in range(NUM_LIMBS):
+                al = (av >> (LIMB_BITS * i)) & (BASE - 1)
+                bl = (bv >> (LIMB_BITS * i)) & (BASE - 1)
+                limbs.append(gate.mul_add(ctx, bit, (al - bl) % R, bl))
+            out.append(self.fp.from_limbs(
+                ctx, limbs, av if bit.value else bv))
+        return tuple(out)
+
+    def _assert_zero_lazy(self, ctx: Context, pair):
+        """Constrain a lazy Fq2 pair == 0 (mod p): reduce, pin r = 0."""
+        for comp in pair:
+            r = self.fp.big.carry_mod_ovf(ctx, comp, P)
+            for limb in r.limbs:
+                ctx.constrain_constant(limb, 0)
+
+    # ------------------------------------------------------------------
+    # sgn0 (RFC 9380, m = 2) over canonicalized components
+    # ------------------------------------------------------------------
+    def _parity_and_zero(self, ctx: Context, a: CrtUint) -> tuple:
+        gate = self.fp.gate
+        rng = self.fp.big.rng
+        l0 = a.limbs[0]
+        b = ctx.load_witness(l0.value & 1)
+        gate.assert_bit(ctx, b)
+        h = ctx.load_witness(l0.value >> 1)
+        rng.range_check(ctx, h, LIMB_BITS - 1)
+        ctx.constrain_equal(gate.mul_add(ctx, h, 2, b), l0)
+        z = None
+        for limb in a.limbs:
+            zi = gate.is_zero(ctx, limb)
+            z = zi if z is None else gate.and_(ctx, z, zi)
+        return b, z
+
+    def sgn0(self, ctx: Context, a) -> AssignedValue:
+        """RFC sgn0 of a CANONICAL Fq2 pair: s0 | (z0 & s1)."""
+        gate = self.fp.gate
+        s0, z0 = self._parity_and_zero(ctx, a[0])
+        s1, _ = self._parity_and_zero(ctx, a[1])
+        return gate.or_(ctx, s0, gate.and_(ctx, z0, s1))
+
+    def _canonical_fq2(self, ctx: Context, a) -> tuple:
+        return (self.fp.canonicalize(ctx, a[0]),
+                self.fp.canonicalize(ctx, a[1]))
+
+    # ------------------------------------------------------------------
+    # simplified SWU on E2' + derived 3-isogeny
+    # ------------------------------------------------------------------
+    def map_to_curve_g2(self, ctx: Context, u) -> tuple:
+        """u: reduced Fq2 pair -> point on E2 (post-isogeny)."""
+        fp2, lz = self.fp2, self.lz
+        A = fp2.load_constant(ctx, bls.SSWU_A)
+        B = fp2.load_constant(ctx, bls.SSWU_B)
+        zconst = bls.SSWU_Z
+
+        u_can = self._canonical_fq2(ctx, u)
+        u2 = lz.reduce(ctx, lz.mul(ctx, u_can, u_can))
+        zu2 = lz.reduce(ctx, lz.mul_const(ctx, u2, zconst))
+        tv1 = lz.reduce(ctx, lz.add(ctx, lz.mul(ctx, zu2, zu2),
+                                    lz.lift(ctx, zu2)))
+        one = fp2.load_constant(ctx, (1, 0))
+        inv_tv1 = fp2.div_unsafe(ctx, one, tv1)     # proves tv1 != 0 too
+        neg_b_over_a = bls.Fq2([0, 0]) - (bls.SSWU_B / bls.SSWU_A)
+        x1 = lz.reduce(ctx, lz.mul_const(
+            ctx, fp2.add(ctx, inv_tv1, one), neg_b_over_a))
+
+        def g_of(x):
+            x2 = lz.reduce(ctx, lz.mul(ctx, x, x))
+            x3 = lz.mul(ctx, x2, x)
+            ax = lz.mul(ctx, A, x)
+            return lz.reduce(ctx, lz.add(ctx, lz.add(ctx, x3, ax),
+                                         lz.lift(ctx, B)))
+
+        gx1 = g_of(x1)
+        # branch bit e1 = is_square(gx1), pinned by w^2 == gx1 * sel with
+        # sel = e1 ? 1 : Z (Z a non-residue, so the bit cannot be flipped)
+        gx1_v = fp2.value(gx1)
+        e1_v = gx1_v.sqrt() is not None
+        e1 = ctx.load_witness(int(e1_v))
+        self.fp.gate.assert_bit(ctx, e1)
+        sel = self._select_const_fq2(ctx, e1, bls.Fq2([1, 0]), zconst)
+        w_v = (gx1_v * fp2.value(sel)).sqrt()
+        assert w_v is not None, "neither gx1 nor gx1*Z is square"
+        w = fp2.load(ctx, w_v)
+        self._assert_zero_lazy(ctx, lz.sub(ctx, lz.mul(ctx, w, w),
+                                           lz.mul(ctx, gx1, sel)))
+
+        x2c = lz.reduce(ctx, lz.mul(ctx, zu2, x1))
+        x_sel = self.fp2.select(ctx, e1, x1, x2c)
+        gx_sel = g_of(x_sel)
+
+        # y: witnessed sign-adjusted root of g(x_sel)
+        gv = fp2.value(gx_sel)
+        y_v = gv.sqrt()
+        assert y_v is not None, "selected branch has no root (SSWU broken)"
+        uv = fp2.value(u_can)
+        if uv.sgn0() != y_v.sgn0():
+            y_v = bls.Fq2([0, 0]) - y_v
+        y = fp2.load(ctx, y_v)
+        self._assert_zero_lazy(ctx, lz.sub(ctx, lz.mul(ctx, y, y),
+                                           lz.lift(ctx, gx_sel)))
+        y_can = self._canonical_fq2(ctx, y)
+        ctx.constrain_equal(self.sgn0(ctx, y_can), self.sgn0(ctx, u_can))
+
+        return self._iso3(ctx, (x_sel, y_can))
+
+    def _iso3(self, ctx: Context, pt) -> tuple:
+        """The Velu-derived 3-isogeny E2' -> E2 (fields/bls12_381.py
+        `iso3_map`), with the division by (x - xq) done via a witnessed
+        inverse (also proving x != xq; the kernel x never occurs for hashed
+        inputs)."""
+        fp2, lz = self.fp2, self.lz
+        xq, t, uq, _cs = bls._iso3_constants()
+        c = bls._ISO3_C
+        c2_const, c3_const = c * c, c * c * c
+        x, y = pt
+        xq_c = fp2.load_constant(ctx, xq)
+        d = fp2.sub(ctx, x, xq_c)
+        one = fp2.load_constant(ctx, (1, 0))
+        i1 = fp2.div_unsafe(ctx, one, d)          # proves d != 0
+        i2 = lz.reduce(ctx, lz.mul(ctx, i1, i1))
+        i3 = lz.reduce(ctx, lz.mul(ctx, i2, i1))
+        # X = c^2 (x + t*i1 + uq*i2) ; Y = c^3 y (1 - t*i2 - 2 uq*i3)
+        tx = lz.mul_const(ctx, i1, t)
+        ux = lz.mul_const(ctx, i2, uq)
+        xs = lz.add(ctx, lz.add(ctx, tx, ux), lz.lift(ctx, x))
+        xx = lz.reduce(ctx, xs)
+        xx = lz.reduce(ctx, lz.mul_const(ctx, xx, c2_const))
+        ti2 = lz.mul_const(ctx, i2, t)
+        ui3 = lz.mul_const(ctx, i3, uq + uq)
+        ys = lz.sub(ctx, lz.sub(ctx, lz.lift(ctx, one), ti2), ui3)
+        yy = lz.reduce(ctx, ys)
+        yy = lz.reduce(ctx, lz.mul(ctx, y, yy))
+        yy = lz.reduce(ctx, lz.mul_const(ctx, yy, c3_const))
+        return (xx, yy)
+
+    # ------------------------------------------------------------------
+    # cofactor clearing (Budroni–Pintore) + full hash
+    # ------------------------------------------------------------------
+    def clear_cofactor(self, ctx: Context, q) -> tuple:
+        """BP: [x^2-x-1]Q + [x-1]psi(Q) + psi^2(2Q) == [H_EFF]Q. The input
+        q is fully constraint-determined (SSWU output), so the lazy
+        non-strict ladder steps pin every slope (see
+        PairingChip.g2_scalar_mul)."""
+        pairing = self.pairing
+        x = bls.BLS_X
+        a = pairing.g2_scalar_mul(ctx, q, x * x - x - 1, strict=False)
+        psi_q = pairing.g2_psi(ctx, q)
+        # [x-1]psi(Q) = [|x|+1] (-psi(Q))
+        neg_psi = (psi_q[0], self.fp2.neg(ctx, psi_q[1]))
+        b = pairing.g2_scalar_mul(ctx, neg_psi, -x + 1, strict=False)
+        two_q, _ = pairing._double_step(ctx, q)
+        c = pairing.g2_psi(ctx, pairing.g2_psi(ctx, two_q))
+        out, _ = pairing._add_step(ctx, a, b, strict=False)
+        out, _ = pairing._add_step(ctx, out, c, strict=False)
+        return out
+
+    def hash_to_g2(self, ctx: Context, msg_bytes: list,
+                   dst: bytes) -> tuple:
+        """Full suite: two field elements, two maps, strict add, cofactor
+        clearing. The witness values are asserted equal to the host
+        `bls.hash_to_g2` (blst-fixture-validated) — a built-in oracle that
+        catches any drift in the chip pipeline at witness-gen time."""
+        u0, u1 = self.hash_to_field_fq2(ctx, msg_bytes, dst)
+        q0 = self.map_to_curve_g2(ctx, u0)
+        q1 = self.map_to_curve_g2(ctx, u1)
+        q = self.g2.add_unequal(ctx, q0, q1, strict=True)
+        out = self.clear_cofactor(ctx, q)
+        msg = bytes(c.value for c in msg_bytes)
+        want = bls.hash_to_g2(msg, dst)
+        got = (self.fp2.value(out[0]), self.fp2.value(out[1]))
+        assert got == want, "hash_to_g2 chip drifted from the host suite"
+        return out
+
+
+def _sha_compress_py(state, block_bytes: bytes):
+    """Minimal host SHA-256 compression (FIPS 180-4) for deriving the
+    constant midstate of expand_message_xmd's all-zero z_pad block."""
+    K = [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+        0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+        0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+        0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+        0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+        0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ]
+    M = 0xFFFFFFFF
+
+    def rotr(x, r):
+        return ((x >> r) | (x << (32 - r))) & M
+
+    w = [int.from_bytes(block_bytes[4 * i:4 * i + 4], "big") for i in range(16)]
+    for i in range(16, 64):
+        s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3)
+        s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10)
+        w.append((w[i - 16] + s0 + w[i - 7] + s1) & M)
+    a, b, c, d, e, f, g, h = state
+    for i in range(64):
+        s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = (h + s1 + ch + K[i] + w[i]) & M
+        s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = (s0 + maj) & M
+        h, g, f, e, d, c, b, a = g, f, e, (d + t1) & M, c, b, a, (t1 + t2) & M
+    return tuple((x + y) & M for x, y in zip(state, (a, b, c, d, e, f, g, h)))
+
+
+_IV = (0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+       0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19)
+_STATE_AFTER_ZERO_BLOCK = _sha_compress_py(_IV, b"\x00" * 64)
+# sanity: streaming equivalence with hashlib on a two-block message
+# (block 2 = 55 data bytes + 0x80 + 8-byte bit length)
+import hashlib as _hl
+_probe = _sha_compress_py(
+    _STATE_AFTER_ZERO_BLOCK,
+    b"\x01" * 55 + b"\x80" + (8 * 119).to_bytes(8, "big"))
+assert b"".join(x.to_bytes(4, "big") for x in _probe) == \
+    _hl.sha256(b"\x00" * 64 + b"\x01" * 55).digest(), "midstate derivation broken"

@@ -68,13 +68,17 @@ class PairingChip:
                                    lz.lift(ctx, y)))
         return (x3, y3), lam
 
-    def _add_step(self, ctx: Context, t_pt, q_pt) -> tuple:
-        """(T+Q, chord slope), strict (x_T != x_Q constrained)."""
+    def _add_step(self, ctx: Context, t_pt, q_pt, strict: bool = True) -> tuple:
+        """(T+Q, chord slope). strict constrains x_T != x_Q; pass False only
+        where T is itself fully constraint-determined (e.g. deterministic
+        ladders over a pinned input), where dx != 0 as witnessed values
+        already pins the slope uniquely."""
         fp2, lz = self.fp2, self.lz
         xt, yt = t_pt
         xq, yq = q_pt
         dx = fp2.sub(ctx, xt, xq)
-        fp2.assert_nonzero(ctx, dx)
+        if strict:
+            fp2.assert_nonzero(ctx, dx)
         dy = fp2.sub(ctx, yt, yq)
         lam = fp2.div_unsafe(ctx, dy, dx)
         lam2 = lz.mul(ctx, lam, lam)
@@ -156,15 +160,25 @@ class PairingChip:
         py = lz.reduce(ctx, lz.mul_const(ctx, fp2.conjugate(ctx, y), cy))
         return (px, py)
 
-    def g2_scalar_mul_abs_x(self, ctx: Context, q_pt) -> tuple:
-        """[|x|] Q by double-and-add (strict adds; Q of prime order r never
-        hits T == +-Q for the partial constants of |x| < r)."""
+    def g2_scalar_mul(self, ctx: Context, q_pt, k: int,
+                      strict: bool = True) -> tuple:
+        """[k]Q (k > 0) by double-and-add over the lazy point steps.
+        strict=False is sound ONLY when Q is itself fully
+        constraint-determined (e.g. a hash-to-curve output): there the
+        witnessed dx != 0 pins every slope. For prover-chosen Q (a
+        signature) keep strict: a crafted low-order Q can hit T == +-Q
+        mid-ladder and an unconstrained slope would forge the rest."""
+        assert k > 0
         t = q_pt
-        for bit in ABS_X_BITS[1:]:
-            t = self.g2.double(ctx, t)
+        for bit in bin(k)[3:]:
+            t, _ = self._double_step(ctx, t)
             if bit == "1":
-                t = self.g2.add_unequal(ctx, t, q_pt, strict=True)
+                t, _ = self._add_step(ctx, t, q_pt, strict=strict)
         return t
+
+    def g2_scalar_mul_abs_x(self, ctx: Context, q_pt) -> tuple:
+        """[|x|] Q for the subgroup check — STRICT (adversarial input)."""
+        return self.g2_scalar_mul(ctx, q_pt, -bls.BLS_X, strict=True)
 
     def assert_g2_subgroup(self, ctx: Context, q_pt):
         """psi(Q) == [x]Q = -[|x|]Q — rejects points outside the r-order

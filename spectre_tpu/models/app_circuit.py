@@ -43,9 +43,19 @@ class AppCircuit:
 
     # -- lifecycle ------------------------------------------------------
     @classmethod
-    def build_context(cls, args, spec) -> Context:
+    def build_context(cls, args, spec, **kwargs) -> Context:
+        """Witness generation with the cyclic GC paused: builder structures
+        hold no reference cycles, and gen-2 collections over tens of
+        millions of cells turn an ~6-minute build into >30 minutes."""
+        import gc
         ctx = Context()
-        cls.build(ctx, args, spec)
+        was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            cls.build(ctx, args, spec, **kwargs)
+        finally:
+            if was_enabled:
+                gc.enable()
         return ctx
 
     @classmethod
@@ -75,9 +85,16 @@ class AppCircuit:
 
     @classmethod
     def mock(cls, args, spec, k: int) -> bool:
+        import gc
         ctx = cls.build_context(args, spec)
-        cfg = ctx.auto_config(k=k, lookup_bits=cls.default_lookup_bits)
-        return mock_prove(cfg, ctx.assignment(cfg))
+        was_enabled = gc.isenabled()
+        gc.disable()     # same no-cycles argument as build_context
+        try:
+            cfg = ctx.auto_config(k=k, lookup_bits=cls.default_lookup_bits)
+            return mock_prove(cfg, ctx.assignment(cfg))
+        finally:
+            if was_enabled:
+                gc.enable()
 
     @classmethod
     def prove(cls, pk: ProvingKey, srs: SRS, args, spec, bk=None) -> bytes:

@@ -1,0 +1,73 @@
+"""In-circuit hash-to-G2 chip tests (reference: halo2-lib HashToCurveChip).
+
+Default tier: expand_message_xmd + hash_to_field vs the host suite, with a
+mock-prove at small k. RUN_SLOW: the full map (SSWU + iso + BP cofactor,
+~11M cells) vs the blst-validated host pipeline."""
+
+import os
+
+import pytest
+
+from spectre_tpu.builder import Context, RangeChip
+from spectre_tpu.builder.fp_chip import FpChip
+from spectre_tpu.builder.fp2_chip import Fp2Chip
+from spectre_tpu.builder.fp12_chip import Fp12Chip
+from spectre_tpu.builder.hash_to_curve_chip import HashToCurveChip
+from spectre_tpu.builder.pairing_chip import PairingChip
+from spectre_tpu.builder.sha256_chip import Sha256Chip
+from spectre_tpu.fields import bls12_381 as bls
+from spectre_tpu.gadgets.ssz_merkle import load_bytes_checked
+from spectre_tpu.plonk.mock import mock_prove
+from spectre_tpu.spec import DST
+
+RUN_SLOW = os.environ.get("RUN_SLOW") == "1"
+
+
+def _chip():
+    ctx = Context()
+    fp2 = Fp2Chip(FpChip(RangeChip(lookup_bits=8)))
+    chip = HashToCurveChip(PairingChip(Fp12Chip(fp2)), Sha256Chip())
+    return ctx, fp2, chip
+
+
+class TestExpandAndField:
+    def test_expand_message_xmd_vs_host(self):
+        msg = b"\x5a" * 32
+        ctx, fp2, chip = _chip()
+        cells = load_bytes_checked(ctx, chip.sha, msg)
+        digs = chip.expand_message_xmd(ctx, cells, DST, 256)
+        got = b"".join(
+            b"".join(int(w.value).to_bytes(4, "big") for w in d) for d in digs)
+        assert got == bls.expand_message_xmd(msg, DST, 256)
+
+    def test_hash_to_field_vs_host_and_mock(self):
+        msg = b"\x21" * 32
+        ctx, fp2, chip = _chip()
+        cells = load_bytes_checked(ctx, chip.sha, msg)
+        us = chip.hash_to_field_fq2(ctx, cells, DST)
+        want = bls.hash_to_field_fq2(msg, DST)
+        for (c0, c1), wv in zip(us, want):
+            assert (c0.value % bls.P, c1.value % bls.P) == \
+                (int(wv.c[0]), int(wv.c[1]))
+        cfg = ctx.auto_config(k=15, lookup_bits=8)
+        assert mock_prove(cfg, ctx.assignment(cfg))
+
+    def test_sgn0_gadget(self):
+        ctx, fp2, chip = _chip()
+        for v, want in (((2, 0), 0), ((3, 0), 1), ((0, 3), 1), ((0, 2), 0),
+                        ((4, 7), 0), ((5, 2), 1)):
+            a = chip._canonical_fq2(ctx, fp2.load(ctx, bls.Fq2(list(v))))
+            assert chip.sgn0(ctx, a).value == want, v
+        cfg = ctx.auto_config(k=13, lookup_bits=8)
+        assert mock_prove(cfg, ctx.assignment(cfg))
+
+
+@pytest.mark.skipif(not RUN_SLOW, reason="~11M cells (set RUN_SLOW=1)")
+class TestFullHashToG2:
+    def test_full_map_vs_host(self):
+        msg = b"\xab" * 32
+        ctx, fp2, chip = _chip()
+        cells = load_bytes_checked(ctx, chip.sha, msg)
+        h = chip.hash_to_g2(ctx, cells, DST)  # built-in oracle assert inside
+        want = bls.hash_to_g2(msg, DST)
+        assert (fp2.value(h[0]), fp2.value(h[1])) == want

@@ -71,7 +71,8 @@ class TestInstanceParity:
         assert [c.value for c in ctx.instance_cells] == \
             CommitteeUpdateCircuit.get_instances(args, TINY)
 
-    @pytest.mark.skipif(not os.environ.get("RUN_SLOW"), reason="~90s witness gen")
+    @pytest.mark.skipif(not os.environ.get("RUN_SLOW"),
+                        reason="~10 min witness gen (full BLS block)")
     def test_step(self):
         args = default_sync_step_args(TINY)
         ctx = StepCircuit.build_context(args, TINY)
@@ -79,11 +80,24 @@ class TestInstanceParity:
             StepCircuit.get_instances(args, TINY)
 
     def test_step_rejects_invalid_signature(self):
+        # fast-fail guard fires before the heavy BLS block is built
         args = default_sync_step_args(TINY)
         args.signature_compressed = bls.g2_compress(
             bls.g2_curve.mul(bls.G2_GEN, 123))
         with pytest.raises(AssertionError, match="aggregate signature invalid"):
             StepCircuit.build_context(args, TINY)
+
+    @pytest.mark.skipif(not os.environ.get("RUN_SLOW"),
+                        reason="~10 min witness gen (full BLS block)")
+    def test_step_rejects_forged_signature_by_constraints(self):
+        """The round-2 flagship property: with the native guard DISABLED, a
+        forged signature still cannot satisfy the constraint system — the
+        in-circuit pairing check rejects it (VERDICT r1 item 1)."""
+        args = default_sync_step_args(TINY)
+        args.signature_compressed = bls.g2_compress(
+            bls.g2_curve.mul(bls.G2_GEN, 123))
+        with pytest.raises(AssertionError):
+            StepCircuit.build_context(args, TINY, native_precheck=False)
 
     def test_native_instances_stable(self):
         args = default_committee_update_args(TINY)
