@@ -1,0 +1,8 @@
+"""EVM layer: Solidity verifier generation + calldata encoding.
+
+Reference parity: snark-verifier's `gen_evm_verifier_shplonk` +
+`encode_calldata` (`util/circuit.rs:182-218`, SURVEY.md L0/N11 and §2a
+"Prover CLI gen-verifier").
+"""
+
+from .codegen import encode_calldata, gen_evm_verifier  # noqa: F401

@@ -49,6 +49,43 @@ class VerifyingKey:
             h.update(bn254.g1_to_bytes(pt))
         return h.digest()
 
+    def commitment_plan(self):
+        """Ordered commitment keys as read from the proof stream, with the
+        challenge boundaries: (keys, pre_beta_gamma, pre_y, pre_x). The
+        SINGLE source for the verifier and the EVM codegen — any change to
+        the prover's write order must land here."""
+        cfg = self.config
+        keys = []
+        for j in range(cfg.num_advice):
+            keys.append(("adv", j))
+        for j in range(cfg.num_lookup_advice):
+            keys.append(("ladv", j))
+        for j in range(cfg.num_lookup_advice):
+            keys.append(("pA", j))
+            keys.append(("pT", j))
+        pre_bg = len(keys)
+        for c in range(cfg.num_perm_chunks):
+            keys.append(("pz", c))
+        for j in range(cfg.num_lookup_advice):
+            keys.append(("lz", j))
+        pre_y = len(keys)
+        for i in range(3):
+            keys.append(("h", i))
+        return keys, pre_bg, pre_y, len(keys)
+
+    def fixed_commitment_map(self) -> dict:
+        """key -> commitment for the vk-side (non-proof) commitments."""
+        out = {}
+        for j, c in enumerate(self.table_commits):
+            out[("tab", j)] = c
+        for j, c in enumerate(self.selector_commits):
+            out[("q", j)] = c
+        for j, c in enumerate(self.fixed_commits):
+            out[("fix", j)] = c
+        for j, c in enumerate(self.sigma_commits):
+            out[("sig", j)] = c
+        return out
+
     def query_plan(self):
         """Ordered (key, rotation) pairs — the eval section of the proof."""
         cfg = self.config

@@ -31,23 +31,17 @@ def verify(vk: VerifyingKey, srs: SRS, instances: list, proof: bytes,
         for v in col:
             tr.common_scalar(int(v) % R)
 
+    keys, pre_bg, pre_y, pre_x = vk.commitment_plan()
     commits = {}
-    for j in range(cfg.num_advice):
-        commits[("adv", j)] = tr.read_point()
-    for j in range(cfg.num_lookup_advice):
-        commits[("ladv", j)] = tr.read_point()
-    for j in range(cfg.num_lookup_advice):
-        commits[("pA", j)] = tr.read_point()
-        commits[("pT", j)] = tr.read_point()
+    for key in keys[:pre_bg]:
+        commits[key] = tr.read_point()
     beta = tr.challenge()
     gamma = tr.challenge()
-    for c in range(cfg.num_perm_chunks):
-        commits[("pz", c)] = tr.read_point()
-    for j in range(cfg.num_lookup_advice):
-        commits[("lz", j)] = tr.read_point()
+    for key in keys[pre_bg:pre_y]:
+        commits[key] = tr.read_point()
     y = tr.challenge()
-    for i in range(3):
-        commits[("h", i)] = tr.read_point()
+    for key in keys[pre_y:pre_x]:
+        commits[key] = tr.read_point()
     x = tr.challenge()
 
     plan = vk.query_plan()
@@ -81,15 +75,7 @@ def verify(vk: VerifyingKey, srs: SRS, instances: list, proof: bytes,
         return False
 
     # --- SHPLONK ---
-    fixed_commits = {}
-    for j, c in enumerate(vk.table_commits):
-        fixed_commits[("tab", j)] = c
-    for j, c in enumerate(vk.selector_commits):
-        fixed_commits[("q", j)] = c
-    for j, c in enumerate(vk.fixed_commits):
-        fixed_commits[("fix", j)] = c
-    for j, c in enumerate(vk.sigma_commits):
-        fixed_commits[("sig", j)] = c
+    fixed_commits = vk.fixed_commitment_map()
 
     by_key: dict = {}
     for key, rot in plan:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from .. import spec as spec_mod
@@ -30,11 +31,14 @@ def main(argv=None):
 
     c = sub.add_parser("circuit", help="circuit lifecycle")
     c.add_argument("which", choices=["sync-step", "committee-update"])
-    c.add_argument("action", choices=["setup", "prove", "verify"])
+    c.add_argument("action", choices=["setup", "prove", "verify",
+                                      "gen-verifier"])
     c.add_argument("--k", type=int, default=17)
     c.add_argument("--witness", help="witness JSON path (default: mock witness)")
     c.add_argument("--proof-out", default="proof.bin")
     c.add_argument("--proof-in")
+    c.add_argument("--sol-out", help="Solidity output path "
+                   "(default: build/<name>_<spec>_<k>_verifier.sol)")
 
     r = sub.add_parser("rpc", help="serve JSON-RPC prover API")
     r.add_argument("--host", default="127.0.0.1")
@@ -94,6 +98,22 @@ def _circuit_cmd(args, spec):
         witness_args = _witness_from_json(args.which, data)
 
     pk = circuit.create_pk(srs, spec, args.k, default_args, bk)
+
+    if args.action == "gen-verifier":
+        # reference: `spectre-prover circuit ... gen-verifier`
+        # (`util/circuit.rs:182-194`)
+        from ..evm import gen_evm_verifier
+        from ..models.app_circuit import BUILD_DIR
+        n_inst = len(circuit.get_instances(default_args, spec))
+        src = gen_evm_verifier(pk.vk, srs, num_instances=n_inst,
+                               contract_name=f"Verifier_{circuit.name}")
+        out = args.sol_out or os.path.join(
+            BUILD_DIR, f"{circuit.name}_{spec.name}_{args.k}_verifier.sol")
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        with open(out, "w") as f:
+            f.write(src)
+        print(json.dumps({"verifier": out, "bytes": len(src)}))
+        return
     if args.action == "prove":
         proof = circuit.prove(pk, srs, witness_args, spec, bk)
         with open(args.proof_out, "wb") as f:
