@@ -53,34 +53,47 @@ class OverflowInt:
 
 
 class BigUintChip:
-    def __init__(self, rng: RangeChip):
+    """num_limbs x limb_bits CRT bigint chip. Defaults match the reference's
+    BLS12-381-over-BN254 shape (5 x 104, `eth-types/src/lib.rs:12-16`); the
+    aggregation layer instantiates 3 x 88 for BN254 Fq (the reference
+    accumulator's limb encoding, snark-verifier `LimbsEncoding<3, 88>`)."""
+
+    def __init__(self, rng: RangeChip, num_limbs: int = NUM_LIMBS,
+                 limb_bits: int = LIMB_BITS):
         self.rng = rng
         self.gate = rng.gate
-        self._pow_native = [pow(BASE, i, R) for i in range(2 * NUM_LIMBS + 2)]
+        self.num_limbs = num_limbs
+        self.limb_bits = limb_bits
+        self.base = 1 << limb_bits
+        self._pow_native = [pow(self.base, i, R) for i in range(2 * num_limbs + 2)]
 
     # -- construction ---------------------------------------------------
     def load(self, ctx: Context, value: int, max_bits: int | None = None) -> CrtUint:
         value = int(value)
         assert value >= 0
-        max_bits = max_bits or NUM_LIMBS * LIMB_BITS
+        max_bits = max_bits or self.num_limbs * self.limb_bits
+        assert max_bits <= self.num_limbs * self.limb_bits, \
+            "value exceeds limb capacity — pick a wider num_limbs/limb_bits"
         assert value < (1 << max_bits)
         limbs = []
-        for i in range(NUM_LIMBS):
-            lv = (value >> (LIMB_BITS * i)) & (BASE - 1)
+        for i in range(self.num_limbs):
+            lv = (value >> (self.limb_bits * i)) & (self.base - 1)
             limb = ctx.load_witness(lv)
-            bits = min(LIMB_BITS, max(max_bits - LIMB_BITS * i, 0))
+            bits = min(self.limb_bits, max(max_bits - self.limb_bits * i, 0))
             if bits == 0:
                 ctx.constrain_constant(limb, 0)
             else:
                 self.rng.range_check(ctx, limb, bits)
             limbs.append(limb)
-        native = self.gate.inner_product_const(ctx, limbs, self._pow_native[:NUM_LIMBS])
+        native = self.gate.inner_product_const(
+            ctx, limbs, self._pow_native[:self.num_limbs])
         return CrtUint(limbs, native, value)
 
     def load_constant(self, ctx: Context, value: int) -> CrtUint:
-        limbs = [ctx.load_constant((value >> (LIMB_BITS * i)) & (BASE - 1))
-                 for i in range(NUM_LIMBS)]
-        native = self.gate.inner_product_const(ctx, limbs, self._pow_native[:NUM_LIMBS])
+        limbs = [ctx.load_constant((value >> (self.limb_bits * i)) & (self.base - 1))
+                 for i in range(self.num_limbs)]
+        native = self.gate.inner_product_const(
+            ctx, limbs, self._pow_native[:self.num_limbs])
         return CrtUint(limbs, native, int(value))
 
     # -- arithmetic (lazy: no reduction) --------------------------------
@@ -90,8 +103,9 @@ class BigUintChip:
         return CrtUint(limbs, native, a.value + b.value)
 
     def mul_no_carry(self, ctx: Context, a: CrtUint, b: CrtUint) -> list:
-        """Limb convolution: returns 2*NUM_LIMBS-1 product-limb cells (each up
-        to ~2^(2*LIMB_BITS + log NUM_LIMBS) — still < r)."""
+        """Limb convolution: returns 2*num_limbs-1 product-limb cells (each up
+        to ~2^(2*limb_bits + log num_limbs) — still < r)."""
+        NUM_LIMBS = self.num_limbs
         out = []
         for k in range(2 * NUM_LIMBS - 1):
             terms_a, terms_b = [], []
@@ -102,18 +116,20 @@ class BigUintChip:
         return out
 
     # -- lazy (no-carry) arithmetic on OverflowInt ----------------------
-    def to_overflow(self, a, val_bits: int = NUM_LIMBS * LIMB_BITS) -> OverflowInt:
+    def to_overflow(self, a, val_bits: int | None = None) -> OverflowInt:
         if isinstance(a, OverflowInt):
             return a
-        return OverflowInt(list(a.limbs), a.value, BASE - 1, 1 << val_bits)
+        val_bits = val_bits or self.num_limbs * self.limb_bits
+        return OverflowInt(list(a.limbs), a.value, self.base - 1, 1 << val_bits)
 
     def mul_ovf(self, ctx: Context, a, b,
-                val_bits: int = NUM_LIMBS * LIMB_BITS) -> OverflowInt:
+                val_bits: int | None = None) -> OverflowInt:
         """Product as overflowed limbs (no reduction). a, b: CrtUint or
         OverflowInt. val_bits bounds each CrtUint operand's |value| — pass
         the tight field bound (e.g. 381 for reduced Fq elements): the
         reduction quotient is sized from it, and the 5-limb quotient caps
         honest accumulations at |value| < ~2^515."""
+        val_bits = val_bits or self.num_limbs * self.limb_bits
         xa, xb = self.to_overflow(a, val_bits), self.to_overflow(b, val_bits)
         la, lb = len(xa.limbs), len(xb.limbs)
         out = []
@@ -128,10 +144,12 @@ class BigUintChip:
                            xa.val_abs * xb.val_abs)
 
     def mul_ovf_const(self, ctx: Context, a, k: int,
-                      val_bits: int = NUM_LIMBS * LIMB_BITS) -> OverflowInt:
+                      val_bits: int | None = None) -> OverflowInt:
         """Product with a non-negative host constant, as a constant-limb
         convolution (inner_product_const — no witness cells for k)."""
         assert k >= 0
+        BASE, LIMB_BITS = self.base, self.limb_bits
+        val_bits = val_bits or self.num_limbs * self.limb_bits
         xa = self.to_overflow(a, val_bits)
         if k == 0:
             zero = ctx.load_constant(0)
@@ -194,6 +212,7 @@ class BigUintChip:
         constant adds), then runs the usual CRT carry chain with carry widths
         sized from the tracked limb bound."""
         gate = self.gate
+        NUM_LIMBS, LIMB_BITS, BASE = self.num_limbs, self.limb_bits, self.base
         limbs, value = list(x.limbs), x.value
         limb_abs = x.limb_abs
         assert abs(value) <= x.val_abs, "OverflowInt value bound violated"
@@ -220,7 +239,7 @@ class BigUintChip:
         # q <= (val_abs + shift)/p < 2*val_abs/p + 1
         q_bits = max((x.val_abs * 2).bit_length() - p.bit_length() + 1, 8)
         assert q_bits <= NUM_LIMBS * LIMB_BITS, \
-            "OverflowInt accumulation too large for the 5-limb quotient — " \
+            "OverflowInt accumulation too large for the limb-width quotient — " \
             "reduce earlier or tighten val_bits"
         assert q_val < (1 << q_bits)
         q = self.load(ctx, q_val, max_bits=q_bits)
@@ -265,6 +284,7 @@ class BigUintChip:
         (a) mod r via natives and (b) over the limb radix via a carry chain
         with range-checked carries. Returns r as a CrtUint."""
         gate = self.gate
+        NUM_LIMBS = self.num_limbs
         q_val, r_val = divmod(prod_value, p)
         q = self.load(ctx, q_val, max_bits=p.bit_length() + 8)
         r = self.load(ctx, r_val, max_bits=p.bit_length())
@@ -293,6 +313,7 @@ class BigUintChip:
     def _qp_identity(self, ctx: Context, q: CrtUint, p: int):
         """The q*p constant-limb convolution (shared by every reduction)."""
         gate = self.gate
+        NUM_LIMBS, LIMB_BITS, BASE = self.num_limbs, self.limb_bits, self.base
         p_limbs = [(p >> (LIMB_BITS * i)) & (BASE - 1) for i in range(NUM_LIMBS)]
         qp_limbs = []
         for k in range(2 * NUM_LIMBS - 1):
@@ -323,8 +344,9 @@ class BigUintChip:
         each is witnessed with an offset so a single unsigned range check
         bounds it."""
         gate = self.gate
+        BASE = self.base
         if carry_bits is None:
-            carry_bits = 2 * LIMB_BITS + NUM_LIMBS.bit_length() + 2 - LIMB_BITS
+            carry_bits = self.limb_bits + self.num_limbs.bit_length() + 2
         offset = 1 << (carry_bits + 1)
         carry_prev = None
         carry_prev_val = 0
@@ -361,7 +383,7 @@ class BigUintChip:
         qp_limbs = self._qp_identity(ctx, q, p)
         self._native_zero(ctx, prod_limbs, qp_limbs, None)
         t_cells, t_vals = [], []
-        for k in range(2 * NUM_LIMBS - 1):
+        for k in range(2 * self.num_limbs - 1):
             t_vals.append(_signed(_val_of(prod_limbs[k])) -
                           _signed(_val_of(qp_limbs[k])))
             t_cells.append(gate.sub(ctx, prod_limbs[k], qp_limbs[k]))
@@ -373,6 +395,7 @@ class BigUintChip:
         a + d == bound-1 over the limb radix. halo2-ecc ProperCrtUint's
         canonicality check (`ADVICE.md` bigint.py finding)."""
         gate = self.gate
+        NUM_LIMBS, LIMB_BITS, BASE = self.num_limbs, self.limb_bits, self.base
         m = bound - 1
         assert 0 <= a.value <= m, "enforce_lt: witness out of range"
         d = self.load(ctx, m - a.value, max_bits=bound.bit_length())

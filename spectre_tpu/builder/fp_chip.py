@@ -16,16 +16,27 @@ P = bls.P
 
 
 class FpChip:
-    def __init__(self, rng: RangeChip):
-        self.big = BigUintChip(rng)
+    """Non-native Fp chip over a run-time modulus. Defaults to BLS12-381 Fq
+    with the spec limb shape; the aggregation layer instantiates it for
+    BN254 Fq with 3 x 88-bit limbs (snark-verifier's accumulator encoding)."""
+
+    def __init__(self, rng: RangeChip, modulus: int = P,
+                 num_limbs: int | None = None, limb_bits: int | None = None):
+        kw = {}
+        if num_limbs is not None:
+            kw["num_limbs"] = num_limbs
+        if limb_bits is not None:
+            kw["limb_bits"] = limb_bits
+        self.big = BigUintChip(rng, **kw)
         self.gate = rng.gate
+        self.p = int(modulus)
 
     def load(self, ctx: Context, v: int) -> CrtUint:
-        v = int(v) % P
-        return self.big.load(ctx, v, max_bits=P.bit_length())
+        v = int(v) % self.p
+        return self.big.load(ctx, v, max_bits=self.p.bit_length())
 
     def load_constant(self, ctx: Context, v: int) -> CrtUint:
-        return self.big.load_constant(ctx, int(v) % P)
+        return self.big.load_constant(ctx, int(v) % self.p)
 
     def add(self, ctx: Context, a: CrtUint, b: CrtUint) -> CrtUint:
         s = self.big.add_no_carry(ctx, a, b)
@@ -33,26 +44,26 @@ class FpChip:
         # padding to 2L-1 limbs with zeros
         zero = ctx.load_constant(0)
         limbs = s.limbs + [zero] * (2 * len(a.limbs) - 1 - len(s.limbs))
-        return self.big.carry_mod(ctx, limbs, s.value, P)
+        return self.big.carry_mod(ctx, limbs, s.value, self.p)
 
     def mul(self, ctx: Context, a: CrtUint, b: CrtUint) -> CrtUint:
         prod = self.big.mul_no_carry(ctx, a, b)
-        return self.big.carry_mod(ctx, prod, a.value * b.value, P)
+        return self.big.carry_mod(ctx, prod, a.value * b.value, self.p)
 
     def sub(self, ctx: Context, a: CrtUint, b: CrtUint) -> CrtUint:
         """a - b mod p: compute via a + (p*k - b) with k s.t. values stay
         non-negative (k=1 suffices since b < p)."""
-        pk = self.big.load_constant(ctx, P)
+        pk = self.big.load_constant(ctx, self.p)
         t = self.big.add_no_carry(ctx, a, pk)
         limbs = [self.gate.sub(ctx, x, y) if y is not None else x
                  for x, y in zip(t.limbs, b.limbs + [None] * (len(t.limbs) - len(b.limbs)))]
-        value = a.value + P - b.value
+        value = a.value + self.p - b.value
         zero = ctx.load_constant(0)
         padded = limbs + [zero] * (2 * len(a.limbs) - 1 - len(limbs))
         native = None
         # rebuild native for the carry path consistency: carry_mod recomputes
         # natives from the limbs, so only limbs + value matter here
-        return self.big.carry_mod(ctx, padded, value, P)
+        return self.big.carry_mod(ctx, padded, value, self.p)
 
     def assert_equal(self, ctx: Context, a: CrtUint, b: CrtUint):
         for x, y in zip(a.limbs, b.limbs):
@@ -62,24 +73,25 @@ class FpChip:
         limbs = [self.gate.mul(ctx, x, k) for x in a.limbs]
         zero = ctx.load_constant(0)
         padded = limbs + [zero] * (2 * len(a.limbs) - 1 - len(limbs))
-        return self.big.carry_mod(ctx, padded, a.value * k, P)
+        return self.big.carry_mod(ctx, padded, a.value * k, self.p)
 
     def div_unsafe(self, ctx: Context, a: CrtUint, b: CrtUint) -> CrtUint:
         """q with q*b = a (mod p); only the product relation is constrained."""
-        q_val = a.value % P * pow(b.value % P, -1, P) % P
+        p = self.p
+        q_val = a.value % p * pow(b.value % p, -1, p) % p
         q = self.load(ctx, q_val)
         prod = self.big.mul_no_carry(ctx, q, b)
-        r = self.big.carry_mod(ctx, prod, q_val * b.value, P)
+        r = self.big.carry_mod(ctx, prod, q_val * b.value, self.p)
         # r must equal a mod p — a is already reduced (< p), so limb equality
         self.assert_equal(ctx, r, self._reduced(ctx, a))
         return q
 
     def _reduced(self, ctx: Context, a: CrtUint) -> CrtUint:
-        if a.value < P:
+        if a.value < self.p:
             return a
         zero = ctx.load_constant(0)
         padded = a.limbs + [zero] * (2 * len(a.limbs) - 1 - len(a.limbs))
-        return self.big.carry_mod(ctx, padded, a.value, P)
+        return self.big.carry_mod(ctx, padded, a.value, self.p)
 
     def from_limbs(self, ctx: Context, limbs: list, value: int) -> CrtUint:
         """CrtUint from existing (range-checked) limb cells."""
@@ -106,22 +118,22 @@ class FpChip:
         witness satisfies the relation when a = 0 mod p. Closes the P == Q
         forgery hole in witness-slope addition (`ADVICE.md` fp_chip finding;
         reference: halo2-ecc strict `ec_add_unequal`)."""
-        av = a.value % P
+        av = a.value % self.p
         assert av != 0, "assert_nonzero: witness is zero"
-        inv = self.load(ctx, pow(av, -1, P))
+        inv = self.load(ctx, pow(av, -1, self.p))
         prod = self.big.mul_no_carry(ctx, a, inv)
         # subtract 1 from the low product limb, then carry the lot to zero
         from ..fields import bn254
         prod0 = self.gate.add(ctx, prod[0], bn254.R - 1)
         self.big.check_carry_to_zero(ctx, [prod0] + prod[1:],
-                                     a.value * inv.value - 1, P)
+                                     a.value * inv.value - 1, self.p)
 
     def canonicalize(self, ctx: Context, a: CrtUint) -> CrtUint:
         """Reduce and enforce the canonical representative r < p (not just
         r < 2^381). Use at circuit boundaries where limbs become public or
         byte-compared (`ADVICE.md` bigint.py finding)."""
         r = self._reduced(ctx, a)
-        self.big.enforce_lt(ctx, r, P)
+        self.big.enforce_lt(ctx, r, self.p)
         return r
 
 
@@ -132,19 +144,26 @@ class EccChip:
     (the 512-iteration aggregation loop of `aggregate_pubkeys:292` builds on
     exactly these ops)."""
 
-    def __init__(self, fp: FpChip):
+    def __init__(self, fp: FpChip, b: int = 4):
+        """b: the short-Weierstrass constant (y^2 = x^3 + b). 4 for
+        BLS12-381 G1, 3 for BN254 G1 (the aggregation layer's curve)."""
         self.fp = fp
+        self.b = b
 
     def load_point(self, ctx: Context, pt) -> tuple:
         x, y = int(pt[0]), int(pt[1])
-        # on-curve check: y^2 == x^3 + 4
+        # on-curve check: y^2 == x^3 + b
         xc = self.fp.load(ctx, x)
         yc = self.fp.load(ctx, y)
+        return self.constrain_on_curve(ctx, xc, yc)
+
+    def constrain_on_curve(self, ctx: Context, xc, yc) -> tuple:
+        """On-curve check for already-loaded coordinates."""
         y2 = self.fp.mul(ctx, yc, yc)
         x2 = self.fp.mul(ctx, xc, xc)
         x3 = self.fp.mul(ctx, x2, xc)
-        four = self.fp.load_constant(ctx, 4)
-        rhs = self.fp.add(ctx, x3, four)
+        bc = self.fp.load_constant(ctx, self.b)
+        rhs = self.fp.add(ctx, x3, bc)
         self.fp.assert_equal(ctx, y2, rhs)
         return (xc, yc)
 

@@ -250,16 +250,8 @@ def gen_evm_verifier(vk: VerifyingKey, srs: SRS, num_instances: int,
     # ---- SHPLONK ----
     squeeze("v", absorb_chunks([("evals", (evals_off, w1_off))]))
     squeeze("uch", [f'hex"50", proof[{w1_off}:{w1_off + 64}]'])
-    # fixed commitments table
-    fixed_commits = {}
-    for j, c in enumerate(vk.table_commits):
-        fixed_commits[("tab", j)] = c
-    for j, c in enumerate(vk.selector_commits):
-        fixed_commits[("q", j)] = c
-    for j, c in enumerate(vk.fixed_commits):
-        fixed_commits[("fix", j)] = c
-    for j, c in enumerate(vk.sigma_commits):
-        fixed_commits[("sig", j)] = c
+    # fixed commitments table (one source with the Python verifier)
+    fixed_commits = vk.fixed_commitment_map()
 
     by_key: dict = {}
     for key, rot in plan:

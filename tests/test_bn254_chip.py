@@ -1,0 +1,80 @@
+"""BN254-Fq instantiation of the generic CRT chips (3 x 88-bit limbs).
+
+The aggregation layer (reference: `aggregation_circuit.rs`, snark-verifier's
+`LimbsEncoding<3, 88>`) does non-native BN254 G1 arithmetic over BN254 Fr
+cells; these tests pin the reparameterized quotient sizing, generic carry
+widths, and the b=3 on-curve check that the BLS-default suite never exercises.
+"""
+
+import random
+
+import pytest
+
+from spectre_tpu.builder.context import Context
+from spectre_tpu.builder.fp_chip import EccChip, FpChip
+from spectre_tpu.builder.range_chip import RangeChip
+from spectre_tpu.fields import bn254
+from spectre_tpu.plonk.mock import mock_prove
+
+P = bn254.P
+
+
+def _fresh(lookup_bits=10):
+    ctx = Context()
+    rng = RangeChip(lookup_bits=lookup_bits)
+    fp = FpChip(rng, modulus=P, num_limbs=3, limb_bits=88)
+    return ctx, rng, fp
+
+
+def _mock(ctx, k=14, lookup_bits=10):
+    cfg = ctx.auto_config(k=k, lookup_bits=lookup_bits)
+    return mock_prove(cfg, ctx.assignment(cfg))
+
+
+class TestBn254Fp:
+    def test_field_ops_match_host(self):
+        random.seed(11)
+        ctx, rng, fp = _fresh()
+        for _ in range(4):
+            a, b = random.randrange(P), random.randrange(P)
+            ac, bc = fp.load(ctx, a), fp.load(ctx, b)
+            assert fp.mul(ctx, ac, bc).value % P == a * b % P
+            assert fp.add(ctx, ac, bc).value % P == (a + b) % P
+            assert fp.sub(ctx, ac, bc).value % P == (a - b) % P
+        assert _mock(ctx)
+
+    def test_canonicalize_and_capacity_guard(self):
+        ctx, rng, fp = _fresh()
+        a = fp.load(ctx, P - 1)
+        fp.canonicalize(ctx, a)
+        assert _mock(ctx)
+        # a modulus wider than the limb capacity must be rejected loudly
+        wide = FpChip(rng, modulus=(1 << 300) - 153, num_limbs=3, limb_bits=88)
+        with pytest.raises(AssertionError, match="limb capacity"):
+            wide.load(ctx, (1 << 299))
+
+    def test_ecc_chain_matches_host(self):
+        ctx, rng, fp = _fresh()
+        ecc = EccChip(fp, b=3)
+        g1 = bn254.g1_curve
+        host = bn254.G1_GEN
+        acc = ecc.load_point(ctx, (int(host[0]), int(host[1])))
+        q_host = g1.double(bn254.G1_GEN)
+        for _ in range(3):
+            q = ecc.load_point(ctx, (int(q_host[0]), int(q_host[1])))
+            acc = ecc.add_unequal(ctx, acc, q)
+            host = g1.add(host, q_host)
+            q_host = g1.double(q_host)
+        assert acc[0].value % P == int(host[0])
+        assert acc[1].value % P == int(host[1])
+        d = ecc.double(ctx, acc)
+        host2 = g1.double(host)
+        assert d[0].value % P == int(host2[0])
+        assert _mock(ctx)
+
+    def test_off_curve_point_rejected(self):
+        ctx, rng, fp = _fresh()
+        ecc = EccChip(fp, b=3)
+        with pytest.raises(AssertionError):
+            ecc.load_point(ctx, (1, 3))  # y^2 != x^3 + 3
+            _mock(ctx)
