@@ -90,6 +90,8 @@ class BigUintChip:
         return CrtUint(limbs, native, value)
 
     def load_constant(self, ctx: Context, value: int) -> CrtUint:
+        assert 0 <= value < (1 << (self.num_limbs * self.limb_bits)), \
+            "constant exceeds limb capacity — pick a wider num_limbs/limb_bits"
         limbs = [ctx.load_constant((value >> (self.limb_bits * i)) & (self.base - 1))
                  for i in range(self.num_limbs)]
         native = self.gate.inner_product_const(
@@ -388,6 +390,15 @@ class BigUintChip:
                           _signed(_val_of(qp_limbs[k])))
             t_cells.append(gate.sub(ctx, prod_limbs[k], qp_limbs[k]))
         self._carry_chain_zero(ctx, t_cells, t_vals)
+
+    def assert_zero_mod(self, ctx: Context, x: OverflowInt, p: int):
+        """Constrain x ≡ 0 (mod p) for a (possibly negative) OverflowInt:
+        one reduction, then pin the witnessed remainder to the constant 0.
+        The lazy-EC workhorse (λ·dx - dy ≡ 0, etc.)."""
+        assert x.value % p == 0, "assert_zero_mod: witness not divisible"
+        r = self.carry_mod_ovf(ctx, x, p)
+        for l in r.limbs:
+            ctx.constrain_constant(l, 0)
 
     def enforce_lt(self, ctx: Context, a: CrtUint, bound: int):
         """Constrain a < bound (a compile-time constant) exactly, not just by

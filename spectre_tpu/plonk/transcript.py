@@ -148,6 +148,83 @@ class Blake2bTranscript(_TranscriptBase):
         return self._state.copy().digest()
 
 
+ACC_LIMB_BITS = 88
+ACC_LIMBS = 3  # per coordinate; snark-verifier LimbsEncoding<3, 88>
+
+
+def point_to_transcript_elements(pt) -> list[int]:
+    """G1 point -> 6 field elements (3 x 88-bit limbs per coordinate), the
+    SAME encoding the aggregation circuit witnesses — so the in-circuit
+    transcript absorbs exactly the cells the MSM operates on."""
+    out = []
+    for coord in (int(pt[0]), int(pt[1])):
+        for i in range(ACC_LIMBS):
+            out.append((coord >> (ACC_LIMB_BITS * i)) & ((1 << ACC_LIMB_BITS) - 1))
+    return out
+
+
+class PoseidonTranscript(_TranscriptBase):
+    """Algebraic Fiat–Shamir over Fr: a Poseidon duplex sponge (same
+    T/RATE/R_F/R_P parameters as the committee commitment, `ops/poseidon.py`).
+
+    Reference parity: snark-verifier's `PoseidonTranscript<NativeLoader>` —
+    the transcript used for snarks destined for in-circuit aggregation, where
+    challenge derivation must be cheap to re-derive as constraints (one
+    permutation per RATE absorbed elements, vs thousands of cells per byte
+    for Blake2b/Keccak). The proof byte stream is identical to the other
+    transcripts; only challenge derivation differs.
+
+    Mirrored cell-for-cell by `builder.transcript_chip.TranscriptChip`.
+
+    Sponge shape: T=3/RATE=2 (pse-poseidon's transcript shape, R_P=57 for
+    x^5 over BN254 Fr) — an order of magnitude cheaper in-circuit than the
+    T=12 committee sponge at transcript-sized absorb counts.
+    """
+
+    T = 3
+    RATE = 2
+    R_F = 8
+    R_P = 57
+
+    def _init_state(self):
+        from ..ops import poseidon as _pos
+        self._pos = _pos
+        self._pending: list[int] = []
+        return [0] * self.T
+
+    # -- algebraic absorbs ------------------------------------------------
+    def _absorb_bytes(self, b: bytes):
+        # only used for the vk digest: split into 16-byte BE chunks (< R)
+        for off in range(0, len(b), 16):
+            self._pending.append(int.from_bytes(b[off:off + 16], "big"))
+
+    def common_point(self, pt):
+        self._pending.extend(point_to_transcript_elements(pt))
+
+    def common_scalar(self, v: int):
+        self._pending.append(int(v) % R)
+
+    # write_point/write_scalar inherited: base methods dispatch to the
+    # common_* overrides above and handle the (shared) proof byte framing
+
+    # -- squeeze ----------------------------------------------------------
+    def challenge(self) -> int:
+        self._counter += 1
+        self._pending.append(self._counter)
+        state = self._state
+        pend = self._pending
+        for off in range(0, len(pend), self.RATE):
+            chunk = pend[off:off + self.RATE]
+            state = ([state[0]]
+                     + [(state[1 + i] + v) % R for i, v in enumerate(chunk)]
+                     + state[1 + len(chunk):])
+            state = self._pos.permute_native(state, t=self.T, r_f=self.R_F,
+                                             r_p=self.R_P)
+        self._pending = []
+        self._state = state
+        return state[1]
+
+
 class KeccakTranscript(_TranscriptBase):
     """Keccak-backed transcript for the EVM verification path: the state is a
     rolling hash h = keccak(h || absorbed)."""

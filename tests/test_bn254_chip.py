@@ -77,4 +77,38 @@ class TestBn254Fp:
         ecc = EccChip(fp, b=3)
         with pytest.raises(AssertionError):
             ecc.load_point(ctx, (1, 3))  # y^2 != x^3 + 3
-            _mock(ctx)
+
+    def test_lazy_ops_match_host_and_mock(self):
+        """The lazy (OverflowInt, one-carry-per-identity) EC path — the
+        aggregation MSM's workhorse: double/add chain vs host math, then
+        full constraint satisfaction."""
+        ctx, rng, fp = _fresh(lookup_bits=12)
+        ecc = EccChip(fp, b=3)
+        g1 = bn254.g1_curve
+        base = bn254.G1_GEN
+        acc = ecc.load_point(ctx, (int(base[0]), int(base[1])))
+        gcell = acc
+        host = base
+        for bit in "0110101":  # scalar 0b10110101 = 181
+            acc = ecc.double_lazy(ctx, acc)
+            host = g1.double(host)
+            if bit == "1":
+                acc = ecc.add_unequal_lazy(ctx, acc, gcell)
+                host = g1.add(host, base)
+        expect = g1.mul(base, 0b10110101)
+        assert host == expect
+        assert acc[0].value % P == int(expect[0])
+        assert acc[1].value % P == int(expect[1])
+        # point select
+        bit = ctx.load_witness(1)
+        sel = ecc.select(ctx, bit, acc, gcell)
+        assert sel[0].value == acc[0].value
+        assert _mock(ctx, k=15, lookup_bits=12)
+
+    def test_lazy_add_rejects_equal_points(self):
+        ctx, rng, fp = _fresh()
+        ecc = EccChip(fp, b=3)
+        g = bn254.G1_GEN
+        a = ecc.load_point(ctx, (int(g[0]), int(g[1])))
+        with pytest.raises(AssertionError, match="P == "):
+            ecc.add_unequal_lazy(ctx, a, a)
