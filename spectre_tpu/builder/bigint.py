@@ -213,6 +213,17 @@ class BigUintChip:
         negative values by first adding a constant multiple of p (limb-wise
         constant adds), then runs the usual CRT carry chain with carry widths
         sized from the tracked limb bound."""
+        return self._reduce_ovf(ctx, x, p, with_remainder=True)
+
+    def assert_zero_mod(self, ctx: Context, x: OverflowInt, p: int):
+        """Constrain x ≡ 0 (mod p) for a (possibly negative) OverflowInt with
+        a quotient-only identity (x + k·p = q·p) — no remainder witness, no
+        remainder range checks. The lazy-EC workhorse (λ·dx - dy ≡ 0, etc.)."""
+        assert x.value % p == 0, "assert_zero_mod: witness not divisible"
+        self._reduce_ovf(ctx, x, p, with_remainder=False)
+
+    def _reduce_ovf(self, ctx: Context, x: OverflowInt, p: int,
+                    with_remainder: bool):
         gate = self.gate
         NUM_LIMBS, LIMB_BITS, BASE = self.num_limbs, self.limb_bits, self.base
         limbs, value = list(x.limbs), x.value
@@ -245,7 +256,9 @@ class BigUintChip:
             "reduce earlier or tighten val_bits"
         assert q_val < (1 << q_bits)
         q = self.load(ctx, q_val, max_bits=q_bits)
-        r = self.load(ctx, r_val, max_bits=p.bit_length())
+        r = (self.load(ctx, r_val, max_bits=p.bit_length())
+             if with_remainder else None)
+        assert with_remainder or r_val == 0
 
         ntot = max(len(limbs), 2 * NUM_LIMBS - 1)
         qp_limbs = self._qp_identity(ctx, q, p)
@@ -270,7 +283,7 @@ class BigUintChip:
         for k in range(ntot):
             tv = _signed(_val_of(limbs[k])) - _signed(_val_of(qp_limbs[k]))
             tc = gate.sub(ctx, limbs[k], qp_limbs[k])
-            if k < NUM_LIMBS:
+            if r is not None and k < NUM_LIMBS:
                 tv -= r.limbs[k].value
                 tc = gate.sub(ctx, tc, r.limbs[k])
             t_cells.append(tc)
@@ -390,15 +403,6 @@ class BigUintChip:
                           _signed(_val_of(qp_limbs[k])))
             t_cells.append(gate.sub(ctx, prod_limbs[k], qp_limbs[k]))
         self._carry_chain_zero(ctx, t_cells, t_vals)
-
-    def assert_zero_mod(self, ctx: Context, x: OverflowInt, p: int):
-        """Constrain x ≡ 0 (mod p) for a (possibly negative) OverflowInt:
-        one reduction, then pin the witnessed remainder to the constant 0.
-        The lazy-EC workhorse (λ·dx - dy ≡ 0, etc.)."""
-        assert x.value % p == 0, "assert_zero_mod: witness not divisible"
-        r = self.carry_mod_ovf(ctx, x, p)
-        for l in r.limbs:
-            ctx.constrain_constant(l, 0)
 
     def enforce_lt(self, ctx: Context, a: CrtUint, bound: int):
         """Constrain a < bound (a compile-time constant) exactly, not just by

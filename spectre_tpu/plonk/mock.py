@@ -5,11 +5,19 @@ first rung of the test ladder (SURVEY.md §4). Evaluates every constraint
 row-wise on the base domain (same `all_expressions` definition as the real
 prover/verifier) and reports the exact (expression, row) of any violation;
 also checks copy constraints and lookup membership directly.
+
+Evaluation runs on the numeric backend's [n, 4] u64 limb arrays (the same
+vectorized field ops the prover uses), with batch-inverted grand products —
+per-row Python bigint loops made multi-megacell circuits (the aggregation
+verifier, the pairing tests) minutes-slow to mock.
 """
 
 from __future__ import annotations
 
+import numpy as np
+
 from ..fields import bn254
+from . import backend as B
 from .constraint_system import Assignment, CircuitConfig
 from .domain import Domain
 from .expressions import all_expressions, perm_column_keys
@@ -18,46 +26,64 @@ from .keygen import ROT_LAST
 R = bn254.R
 
 
-class _RowCtx:
-    """Expression context over full value columns (python int lists);
-    rotations are index shifts mod n."""
+class _ArrCtx:
+    """Expression context over [n,4] u64 backend arrays; rotations are index
+    shifts mod n."""
 
-    def __init__(self, cfg: CircuitConfig, dom: Domain, columns: dict):
+    def __init__(self, cfg: CircuitConfig, dom: Domain, columns: dict, bk):
         self._cfg = cfg
         self._cols = columns
+        self._bk = bk
+        self._const_cache: dict = {}
         n = cfg.n
-        omega_pows = [1] * n
-        for i in range(1, n):
-            omega_pows[i] = omega_pows[i - 1] * dom.omega % R
-        self.x_col = omega_pows
-        self.l0 = [1] + [0] * (n - 1)
-        self.llast = [1 if i == cfg.last_row else 0 for i in range(n)]
-        self.lblind = [1 if i > cfg.usable_rows else 0 for i in range(n)]
+        self.x_col = bk.powers(dom.omega, n)
+        l0 = np.zeros((n, 4), dtype=np.uint64)
+        l0[0, 0] = 1
+        self.l0 = l0
+        llast = np.zeros((n, 4), dtype=np.uint64)
+        llast[cfg.last_row, 0] = 1
+        self.llast = llast
+        lblind = np.zeros((n, 4), dtype=np.uint64)
+        lblind[cfg.usable_rows + 1:, 0] = 1
+        self.lblind = lblind
 
     def var(self, key, rot):
         col = self._cols[key]
-        n = len(col)
         if rot == ROT_LAST:
             rot = self._cfg.last_row
-        return [col[(i + rot) % n] for i in range(n)]
+        return np.roll(col, -rot, axis=0) if rot else col
 
     def mul(self, a, b):
-        return [x * y % R for x, y in zip(a, b)]
+        return self._bk.mul(a, b)
 
     def add(self, a, b):
-        return [(x + y) % R for x, y in zip(a, b)]
+        return self._bk.add(a, b)
 
     def sub(self, a, b):
-        return [(x - y) % R for x, y in zip(a, b)]
+        return self._bk.sub(a, b)
 
     def scale(self, a, s):
-        return [x * s % R for x in a]
+        return self._bk.scale(a, s % R)
 
     def add_const(self, a, s):
-        return [(x + s) % R for x in a]
+        return self._bk.add(a, self.const(s))
 
     def const(self, s):
-        return [s % R] * self._cfg.n
+        s = s % R
+        arr = self._const_cache.get(s)
+        if arr is None:
+            from ..native import host
+            arr = np.tile(host.ints_to_limbs([s]), (self._cfg.n, 1))
+            self._const_cache[s] = arr
+        return arr
+
+
+def _running_product(bk, ratio_arr, start: int, u: int, n: int) -> list[int]:
+    """z[0]=start; z[i+1]=z[i]*ratio[i] for i<u; constant afterwards."""
+    pref = B.arr_to_ints(bk.prefix_prod(ratio_arr[:u]))
+    z = [start] + [start * p % R for p in pref]
+    z += [z[u]] * (n - len(z))
+    return z
 
 
 def mock_prove(cfg: CircuitConfig, assignment: Assignment, fixed_values=None,
@@ -68,6 +94,7 @@ def mock_prove(cfg: CircuitConfig, assignment: Assignment, fixed_values=None,
     the assignment — callers can mock-check a circuit without an SRS."""
     from .constraint_system import build_sigma, permute_lookup, table_column
 
+    bk = B.get_backend()
     dom = Domain(cfg.k)
     n, u = cfg.n, cfg.usable_rows
     fixed_values = fixed_values or [list(map(int, f)) for f in assignment.fixed]
@@ -77,8 +104,9 @@ def mock_prove(cfg: CircuitConfig, assignment: Assignment, fixed_values=None,
                                     for j in range(cfg.num_lookup_advice)]
 
     # --- direct checks first (better error messages than the polynomial ones) ---
+    keys = perm_column_keys(cfg)
+
     def cell(col_idx, row):
-        keys = perm_column_keys(cfg)
         kind, j = keys[col_idx]
         src = {"adv": assignment.advice, "ladv": assignment.lookup_advice,
                "fix": fixed_values}.get(kind)
@@ -100,67 +128,68 @@ def mock_prove(cfg: CircuitConfig, assignment: Assignment, fixed_values=None,
     beta, gamma = 0xBEEF, 0xCAFE  # any nonzero values work for satisfaction
     columns = {}
     for j, v in enumerate(assignment.advice):
-        columns[("adv", j)] = [int(x) % R for x in v]
+        columns[("adv", j)] = B.to_arr([int(x) % R for x in v])
     for j, v in enumerate(assignment.lookup_advice):
-        columns[("ladv", j)] = [int(x) % R for x in v]
+        columns[("ladv", j)] = B.to_arr([int(x) % R for x in v])
     for j, v in enumerate(fixed_values):
-        columns[("fix", j)] = [int(x) % R for x in v]
+        columns[("fix", j)] = B.to_arr([int(x) % R for x in v])
     for j, v in enumerate(selector_values):
-        columns[("q", j)] = [int(x) % R for x in v]
+        columns[("q", j)] = B.to_arr([int(x) % R for x in v])
     for j, v in enumerate(sigma_values):
-        columns[("sig", j)] = [int(x) % R for x in v]
+        columns[("sig", j)] = B.to_arr([int(x) % R for x in v])
     for j in range(cfg.num_lookup_advice):
-        columns[("tab", j)] = [int(x) % R for x in table_values[j]]
+        columns[("tab", j)] = B.to_arr([int(x) % R for x in table_values[j]])
     for j in range(cfg.num_instance):
-        columns[("inst", j)] = assignment.instance_column(j)
+        columns[("inst", j)] = B.to_arr(assignment.instance_column(j))
 
-    # grand products, mirroring the prover
+    # grand products, mirroring the prover (vectorized: the per-chunk
+    # num/den columns are backend products with ONE batch inversion)
     from .constraint_system import PERM_CHUNK
     from .domain import DELTA
+    from ..native import host
     col_keys = perm_column_keys(cfg)
-    omega_pows = [1] * n
-    for i in range(1, n):
-        omega_pows[i] = omega_pows[i - 1] * dom.omega % R
+    omega_pows = bk.powers(dom.omega, n)
     prev_end = 1
+    beta_arr = np.tile(host.ints_to_limbs([beta]), (n, 1))
+    gamma_arr = np.tile(host.ints_to_limbs([gamma]), (n, 1))
     for ch in range(cfg.num_perm_chunks):
         cols = list(enumerate(col_keys))[ch * PERM_CHUNK:(ch + 1) * PERM_CHUNK]
-        z = [0] * n
-        z[0] = prev_end
-        for i in range(n):
-            if i + 1 < n:
-                if i < u:
-                    num = den = 1
-                    for gidx, key in cols:
-                        v = columns[key][i]
-                        num = num * ((v + beta * pow(DELTA, gidx, R) * omega_pows[i] + gamma) % R) % R
-                        den = den * ((v + beta * sigma_values[gidx][i] + gamma) % R) % R
-                    z[i + 1] = z[i] * num % R * pow(den, -1, R) % R
-                else:
-                    z[i + 1] = z[i]
+        num = None
+        den = None
+        for gidx, key in cols:
+            v = columns[key]
+            nterm = bk.add(bk.add(v, bk.scale(omega_pows,
+                                              beta * pow(DELTA, gidx, R) % R)),
+                           gamma_arr)
+            dterm = bk.add(bk.add(v, bk.scale(columns[("sig", gidx)], beta)),
+                           gamma_arr)
+            num = nterm if num is None else bk.mul(num, nterm)
+            den = dterm if den is None else bk.mul(den, dterm)
+        ratio = bk.mul(num[:u], bk.inv(den[:u]))
+        z = _running_product(bk, ratio, prev_end, u, n)
         prev_end = z[u]
-        columns[("pz", ch)] = z
+        columns[("pz", ch)] = B.to_arr(z)
     assert prev_end == 1, "permutation grand product != 1"
 
     for j in range(cfg.num_lookup_advice):
-        pa, pt = permute_lookup(cfg, columns[("ladv", j)], table_values[j])
-        columns[("pA", j)] = pa
-        columns[("pT", j)] = pt
-        z = [0] * n
-        z[0] = 1
-        for i in range(n):
-            if i + 1 < n:
-                if i < u:
-                    num = (columns[("ladv", j)][i] + beta) % R * ((table_values[j][i] + gamma) % R) % R
-                    den = (pa[i] + beta) % R * ((pt[i] + gamma) % R) % R
-                    z[i + 1] = z[i] * num % R * pow(den, -1, R) % R
-                else:
-                    z[i + 1] = z[i]
-        columns[("lz", j)] = z
+        pa, pt = permute_lookup(cfg, B.arr_to_ints(columns[("ladv", j)]),
+                                table_values[j])
+        columns[("pA", j)] = B.to_arr(pa)
+        columns[("pT", j)] = B.to_arr(pt)
+        num = bk.mul(bk.add(columns[("ladv", j)], beta_arr),
+                     bk.add(columns[("tab", j)], gamma_arr))
+        den = bk.mul(bk.add(columns[("pA", j)], beta_arr),
+                     bk.add(columns[("pT", j)], gamma_arr))
+        ratio = bk.mul(num[:u], bk.inv(den[:u]))
+        columns[("lz", j)] = B.to_arr(_running_product(bk, ratio, 1, u, n))
 
-    ctx = _RowCtx(cfg, dom, columns)
+    ctx = _ArrCtx(cfg, dom, columns, bk)
     exprs = all_expressions(cfg, ctx, beta, gamma)
     for ei, vals in enumerate(exprs):
-        for i in range(n):
-            assert vals[i] == 0, \
-                f"constraint #{ei} violated at row {i} (value {vals[i]})"
+        nz = np.nonzero(vals.any(axis=1))[0]
+        if len(nz):
+            row = int(nz[0])
+            val = B.arr_to_ints(vals[row:row + 1])[0]
+            raise AssertionError(
+                f"constraint #{ei} violated at row {row} (value {val})")
     return True
