@@ -49,7 +49,9 @@ def deterministic_point(tag: bytes):
         rhs = (x * x % P * x + 3) % P
         y = tonelli_shanks(rhs, P)
         if y is not None:
-            return (x, min(y, P - y))
+            # Fq-wrapped: curve-group ops on plain ints silently skip
+            # the modular reduction
+            return (bn254.Fq(x), bn254.Fq(min(y, P - y)))
         x = (x + 1) % P
 
 

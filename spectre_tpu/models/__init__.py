@@ -6,6 +6,8 @@ Reference parity (SURVEY.md L3): `sync_step_circuit.rs` (StepCircuit),
 the builder chips and proved by the plonk backend (cpu or tpu).
 """
 
+from .aggregation import (AggregationArgs, AggregationCircuit,  # noqa: F401
+                          Accumulator)
 from .app_circuit import AppCircuit  # noqa: F401
 from .committee_update import CommitteeUpdateCircuit  # noqa: F401
 from .step import StepCircuit  # noqa: F401

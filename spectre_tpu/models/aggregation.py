@@ -1,32 +1,32 @@
-"""Proof aggregation / compression layer.
+"""Proof aggregation / compression circuit.
 
-Reference parity: `aggregation_circuit.rs` (snark-verifier's
-`AggregationCircuit`: one-layer SHPLONK compression of an app snark, keeping
-the 12 KZG accumulator limbs + the app instances as public inputs).
+Reference parity: `aggregation_circuit.rs:69-124` — snark-verifier's
+`AggregationCircuit`: one-layer SHPLONK compression of an app snark. The
+inner proof (generated with the Poseidon transcript) is verified entirely
+in-circuit (`plonk/in_circuit.py`); the final pairing is NOT performed —
+its two G1 inputs are exposed as 12 x 88-bit limbs followed by the app
+instances (`expose_previous_instances(false)` layout), so the outer
+verifier (EVM contract or host) finishes with ONE pairing check.
 
-ROUND-1 SCOPE: recursive in-circuit verification of a BN254 KZG proof needs
-the non-native Fq ECC chip (the same machinery as the in-circuit BLS pairing)
-— that is the round-2 milestone. This module already provides:
-  * the aggregation STATEMENT layout (accumulator limbs || app instances),
-    matching `expose_previous_instances(false)`;
-  * KZG accumulation of the deferred pairing checks of N app proofs into ONE
-    pairing (the heart of the aggregation argument, runs natively today and
-    becomes the in-circuit constraint in round 2);
-  * batch verification API used by the RPC/CLI layer.
+Statement: [lhs.x (3), lhs.y (3), rhs.x (3), rhs.y (3), app instances...]
+where e(lhs, [tau]_2) == e(rhs, [1]_2) iff the inner proof verifies.
 """
 
 from __future__ import annotations
 
-import secrets
 from dataclasses import dataclass
 
+from ..builder.range_chip import RangeChip
 from ..fields import bn254
 from ..plonk.srs import SRS
+from ..plonk.transcript import PoseidonTranscript
 from ..plonk.verifier import verify as plonk_verify
+from .app_circuit import AppCircuit
 
 R = bn254.R
 ACC_LIMB_BITS = 88
 ACC_LIMBS_PER_COORD = 3  # 12 limbs total: (lhs.x, lhs.y, rhs.x, rhs.y) x 3
+NUM_ACC_LIMBS = 12
 
 
 @dataclass
@@ -38,7 +38,7 @@ class Accumulator:
 
     def limbs(self) -> list[int]:
         """12 x 88-bit limbs, the aggregation circuit's first instances
-        (reference: accumulator limb encoding in snark-verifier)."""
+        (reference: snark-verifier `LimbsEncoding<3, 88>`)."""
         out = []
         for pt in (self.lhs, self.rhs):
             for coord in (int(pt[0]), int(pt[1])):
@@ -46,6 +46,16 @@ class Accumulator:
                     out.append((coord >> (ACC_LIMB_BITS * i))
                                & ((1 << ACC_LIMB_BITS) - 1))
         return out
+
+    @classmethod
+    def from_limbs(cls, limbs: list) -> "Accumulator":
+        assert len(limbs) >= NUM_ACC_LIMBS
+        coords = []
+        for c in range(4):
+            v = sum(int(limbs[3 * c + i]) << (ACC_LIMB_BITS * i)
+                    for i in range(ACC_LIMBS_PER_COORD))
+            coords.append(bn254.Fq(v))
+        return cls(lhs=(coords[0], coords[1]), rhs=(coords[2], coords[3]))
 
     def check(self, srs: SRS) -> bool:
         g1 = bn254.g1_curve
@@ -56,29 +66,98 @@ class Accumulator:
 
 
 def accumulate(accs: list[Accumulator]) -> Accumulator:
-    """Random-linear-combination of deferred pairing checks into one."""
+    """Linear-combination of deferred pairing checks into one. Challenges are
+    transcript-derived from the accumulator points themselves (Fiat–Shamir,
+    re-derivable by any verifier — `ADVICE.md` round-1: local randomness is
+    unusable for an in-circuit accumulator)."""
     g1 = bn254.g1_curve
+    tr = PoseidonTranscript()
+    for acc in accs:
+        tr.common_point(acc.lhs)
+        tr.common_point(acc.rhs)
     lhs, rhs = None, None
     for acc in accs:
-        r = secrets.randbelow(R)
+        r = tr.challenge()
         lhs = g1.add(lhs, g1.mul(acc.lhs, r))
         rhs = g1.add(rhs, g1.mul(acc.rhs, r))
     return Accumulator(lhs, rhs)
 
 
-class AggregationCircuit:
-    """Round-1 API shell: batch-verifies app proofs and produces the
-    aggregation statement (accumulator limbs || flattened app instances)."""
+@dataclass
+class AggregationArgs:
+    """Witness for one compression layer: the inner proof and its context."""
+
+    inner_vk: object            # plonk VerifyingKey of the app circuit
+    srs: SRS
+    inner_instances: list       # [[int]] app public inputs
+    proof: bytes                # Poseidon-transcript app proof
+
+
+class AggregationCircuit(AppCircuit):
+    """In-circuit SHPLONK verification of one app snark.
+
+    The app snark must be generated with `PoseidonTranscript` (the
+    aggregation-bound transcript, reference: snark-verifier's
+    `gen_snark_shplonk`); the outer proof itself can use any transcript —
+    Keccak for the EVM path (`gen_evm_proof_shplonk` role)."""
 
     name = "aggregation"
+    default_lookup_bits = 14
 
     @classmethod
-    def aggregate_statement(cls, acc: Accumulator, app_instances: list) -> list:
-        return acc.limbs() + [v % R for v in app_instances]
+    def variant(cls, inner_name: str):
+        """Subclass with a distinct name, so pk/pinning caches of different
+        inner circuits don't collide (reference: per-circuit verifier pkeys
+        in `ProverState::new`)."""
+        return type(f"AggregationCircuit_{inner_name}", (cls,),
+                    {"name": f"aggregation_{inner_name}"})
+
+    @classmethod
+    def build(cls, ctx, args: AggregationArgs, spec):
+        from ..plonk.in_circuit import VerifierChip
+        rng = RangeChip(lookup_bits=cls.default_lookup_bits)
+        vc = VerifierChip(rng)
+        inst_cells = [[ctx.load_witness(int(v) % R) for v in col]
+                      for col in args.inner_instances]
+        lhs, rhs = vc.verify_proof(ctx, args.inner_vk, args.srs,
+                                   inst_cells, args.proof)
+        # accumulator limbs: canonical representatives (the statement is
+        # compared coordinate-for-coordinate by the outer pairing check)
+        out = []
+        for pt in (lhs, rhs):
+            for coord in pt:
+                can = vc.fq.canonicalize(ctx, coord)
+                out.extend(can.limbs)
+        for cell in out:
+            ctx.expose_public(cell)
+        for col in inst_cells:
+            for cell in col:
+                ctx.expose_public(cell)
+        return out
+
+    @classmethod
+    def get_instances(cls, args: AggregationArgs, spec) -> list:
+        from ..plonk.in_circuit import VerifierChip
+        acc = VerifierChip.native_accumulator(
+            args.inner_vk, args.srs, args.inner_instances, args.proof)
+        assert acc is not None, "inner proof invalid"
+        flat = [int(v) % R for col in args.inner_instances for v in col]
+        return acc.limbs() + flat
+
+    @classmethod
+    def verify(cls, vk, srs: SRS, instances, proof: bytes,
+               transcript_cls=None) -> bool:
+        """Outer proof verification INCLUDING the deferred pairing: the
+        complete check a consumer of the compressed proof performs."""
+        kw = {"transcript_cls": transcript_cls} if transcript_cls else {}
+        if not plonk_verify(vk, srs, [instances], proof, **kw):
+            return False
+        return Accumulator.from_limbs(instances[:NUM_ACC_LIMBS]).check(srs)
 
     @classmethod
     def batch_verify(cls, vk, srs: SRS, items: list) -> bool:
-        """items: [(instances, proof)] — verifies each app proof (native;
-        becomes one recursive proof in round 2)."""
-        return all(plonk_verify(vk, srs, [inst], proof)
+        """items: [(instances, proof)] — native batch verification of app
+        proofs (the pre-compression fast path used by the RPC layer)."""
+        return all(plonk_verify(vk, srs, [inst], proof,
+                                transcript_cls=PoseidonTranscript)
                    for inst, proof in items)

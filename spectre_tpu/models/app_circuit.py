@@ -66,13 +66,18 @@ class AppCircuit:
     def create_pk(cls, srs: SRS, spec, k: int, dummy_args, bk=None,
                   cache: bool = True):
         """Keygen from a default witness; pin the shape; cache pk to disk
-        (reference: pk written next to pinning, `util/circuit.rs:130-136`)."""
+        (reference: pk written next to pinning, `util/circuit.rs:130-136`).
+        dummy_args may be a zero-arg callable, evaluated only on cache miss."""
         bk = bk or B.get_backend()
         pk_path = os.path.join(BUILD_DIR, f"{cls.name}_{spec.name}_{k}.pk")
         pin_path = cls.pinning_path(spec, k)
         if cache and os.path.exists(pk_path) and os.path.exists(pin_path):
             with open(pk_path, "rb") as f:
                 return pickle.load(f)
+        if callable(dummy_args):
+            # lazy: aggregation dummy args cost a full inner proof — only
+            # pay it on a cache miss
+            dummy_args = dummy_args()
         ctx = cls.build_context(dummy_args, spec)
         pin = Pinning.load_or_create(pin_path, ctx, k, cls.default_lookup_bits)
         asg = ctx.assignment(pin.config)
@@ -97,10 +102,14 @@ class AppCircuit:
                 gc.enable()
 
     @classmethod
-    def prove(cls, pk: ProvingKey, srs: SRS, args, spec, bk=None) -> bytes:
+    def prove(cls, pk: ProvingKey, srs: SRS, args, spec, bk=None,
+              transcript=None) -> bytes:
+        """transcript: None = Blake2b; pass PoseidonTranscript() for
+        aggregation-bound snarks, KeccakTranscript() for the EVM path
+        (reference: gen_snark_shplonk vs gen_evm_proof_shplonk)."""
         ctx = cls.build_context(args, spec)
         asg = ctx.assignment(pk.vk.config)
-        return plonk_prove(pk, srs, asg, bk)
+        return plonk_prove(pk, srs, asg, bk, transcript=transcript)
 
     @classmethod
     def verify(cls, vk, srs: SRS, instances, proof: bytes) -> bool:

@@ -110,12 +110,12 @@ class StepCircuit(AppCircuit):
                                             yc.limbs)
             limbs_list.append(xc.limbs)
             sign_cells.append(sign)
-            summed = ecc.add_unequal(ctx, acc, pt, strict=True)
+            summed = ecc.add_unequal_lazy(ctx, acc, pt)  # strict chord
             acc = (fp.select(ctx, bit_cell, summed[0], acc[0]),
                    fp.select(ctx, bit_cell, summed[1], acc[1]))
         neg_blind = fp.load_constant_point(
             ctx, bls.g1_curve.neg(AGG_BLIND))
-        agg_pk = ecc.add_unequal(ctx, acc, neg_blind, strict=True)
+        agg_pk = ecc.add_unequal_lazy(ctx, acc, neg_blind)
         poseidon_commit = PC.g1_array_poseidon(ctx, gate, poseidon,
                                                limbs_list, sign_cells)
 

@@ -167,9 +167,12 @@ def _pad(coeffs, n):
     return out
 
 
-def shplonk_verify(srs: SRS, entries: list[OpenEntry], transcript) -> bool:
-    """Verifier: reads W1, W2; one pairing check. entries carry commitments
-    and claimed evals (already absorbed by the caller)."""
+def shplonk_accumulate(srs: SRS, entries: list[OpenEntry], transcript):
+    """Verifier scalar/MSM work WITHOUT the pairing: returns the deferred
+    check (lhs, rhs) with e(lhs, [1]_2) == e(rhs... — concretely the pair
+    (w2, f_acc + u*w2) satisfying e(f_acc + u*w2, [1]_2) == e(w2, [tau]_2).
+    One definition serves shplonk_verify AND the aggregation layer's native
+    accumulator oracle (`plonk/in_circuit.py`)."""
     g1 = bn254.g1_curve
     v = transcript.challenge()
     w1 = transcript.read_point()
@@ -200,9 +203,14 @@ def shplonk_verify(srs: SRS, entries: list[OpenEntry], transcript) -> bool:
     f_acc = g1.add(f_acc, g1.neg(g1.mul(bn254.G1_GEN, e_scalar)))
     f_acc = g1.add(f_acc, g1.neg(g1.mul(w1, z_t_u)))
 
-    # e(F + u W2, [1]_2) == e(W2, [tau]_2)
-    lhs = g1.add(f_acc, g1.mul(w2, u))
+    # deferred: e(F + u W2, [1]_2) == e(W2, [tau]_2)
+    return w2, g1.add(f_acc, g1.mul(w2, u))
+
+
+def shplonk_verify(srs: SRS, entries: list[OpenEntry], transcript) -> bool:
+    """Verifier: reads W1, W2; one pairing check."""
+    tau_side, one_side = shplonk_accumulate(srs, entries, transcript)
     return bn254.pairing_check([
-        (lhs, srs.g2_gen),
-        (g1.neg(w2), srs.g2_tau),
+        (one_side, srs.g2_gen),
+        (bn254.g1_curve.neg(tau_side), srs.g2_tau),
     ])

@@ -33,7 +33,13 @@ class SRS:
 
     @classmethod
     def unsafe_setup(cls, k: int, seed: bytes = b"spectre-tpu-test-srs") -> "SRS":
-        tau = int.from_bytes(hashlib.sha256(seed + bytes([k])).digest() * 2, "big") % R
+        """tau depends on the seed ONLY (not k): different-k setups from one
+        seed share tau, so a small SRS is a prefix of a large one — the
+        ceremony-transcript property the aggregation layer requires (the
+        deferred pairing of an inner proof at k1 is checked by the outer
+        layer against the SAME [tau]_2; reference: per-k params files
+        truncated from one perpetual-powers-of-tau ceremony)."""
+        tau = int.from_bytes(hashlib.sha256(seed).digest() * 2, "big") % R
         n = 1 << k
         g1p = host.g1_scalar_powers((int(bn.G1_GEN[0]), int(bn.G1_GEN[1])), tau, n) \
             if (bn := bn254) else None
@@ -66,7 +72,7 @@ class SRS:
     # -- serialization: header || g1 limbs || g2 points (uncompressed BE) --
     def write(self, path: str):
         with open(path, "wb") as f:
-            f.write(b"SPTSRS01")
+            f.write(b"SPTSRS02")
             f.write(self.k.to_bytes(4, "little"))
             f.write(self.g1_powers.astype("<u8").tobytes())
             f.write(bn254.g2_to_bytes(self.g2_gen))
@@ -76,7 +82,8 @@ class SRS:
     def read(cls, path: str) -> "SRS":
         with open(path, "rb") as f:
             magic = f.read(8)
-            assert magic == b"SPTSRS01", "bad SRS file"
+            assert magic == b"SPTSRS02", \
+                "bad/stale SRS file (tau derivation changed in SPTSRS02; delete the params dir)"
             k = int.from_bytes(f.read(4), "little")
             n = 1 << k
             g1 = np.frombuffer(f.read(n * 8 * 8), dtype="<u8").reshape(n, 8).copy()

@@ -20,6 +20,24 @@ R = bn254.R
 
 def verify(vk: VerifyingKey, srs: SRS, instances: list, proof: bytes,
            transcript_cls=Blake2bTranscript) -> bool:
+    acc = verify_deferred(vk, srs, instances, proof, transcript_cls)
+    if acc is None:
+        return False
+    tau_side, one_side = acc
+    g1 = bn254.g1_curve
+    return bn254.pairing_check([
+        (one_side, srs.g2_gen),
+        (g1.neg(tau_side), srs.g2_tau),
+    ])
+
+
+def verify_deferred(vk: VerifyingKey, srs: SRS, instances: list, proof: bytes,
+                    transcript_cls=Blake2bTranscript):
+    """Everything but the pairing: transcript replay, identity at x, SHPLONK
+    combination. Returns the deferred check (tau_side, one_side) with
+    e(tau_side, [tau]_2) == e(one_side, [1]_2), or None if the polynomial
+    identity fails. The aggregation layer's native accumulator oracle and
+    `verify` share this single definition."""
     cfg = vk.config
     dom = vk.domain
     n, u = cfg.n, cfg.usable_rows
@@ -72,7 +90,7 @@ def verify(vk: VerifyingKey, srs: SRS, instances: list, proof: bytes,
     h_at_x = (evals[(("h", 0), 0)] + xn * evals[(("h", 1), 0)]
               + xn * xn % R * evals[(("h", 2), 0)]) % R
     if acc != h_at_x * vanishing % R:
-        return False
+        return None
 
     # --- SHPLONK ---
     fixed_commits = vk.fixed_commitment_map()
@@ -89,7 +107,6 @@ def verify(vk: VerifyingKey, srs: SRS, instances: list, proof: bytes,
         # decides where it comes from
         com = commits[key] if key in commits else fixed_commits[key]
         entries.append(kzg.OpenEntry(None, com, pts, evs))
-    ok = kzg.shplonk_verify(srs, entries, tr)
-    if ok:
-        tr.assert_consumed()
-    return ok
+    tau_side, one_side = kzg.shplonk_accumulate(srs, entries, tr)
+    tr.assert_consumed()
+    return tau_side, one_side

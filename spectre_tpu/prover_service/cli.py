@@ -30,10 +30,14 @@ def main(argv=None):
     sub = p.add_subparsers(dest="cmd", required=True)
 
     c = sub.add_parser("circuit", help="circuit lifecycle")
-    c.add_argument("which", choices=["sync-step", "committee-update"])
+    c.add_argument("which", choices=["sync-step", "committee-update",
+                                     "sync-step-compressed",
+                                     "committee-update-compressed"])
     c.add_argument("action", choices=["setup", "prove", "verify",
                                       "gen-verifier"])
     c.add_argument("--k", type=int, default=17)
+    c.add_argument("--k-agg", type=int, default=17,
+                   help="aggregation circuit degree (compressed variants)")
     c.add_argument("--witness", help="witness JSON path (default: mock witness)")
     c.add_argument("--proof-out", default="proof.bin")
     c.add_argument("--proof-in")
@@ -46,6 +50,9 @@ def main(argv=None):
     r.add_argument("--k-step", type=int, default=17)
     r.add_argument("--k-committee", type=int, default=17)
     r.add_argument("--concurrency", type=int, default=1)
+    r.add_argument("--compress", action="store_true",
+                   help="serve two-stage (aggregated) EVM proofs")
+    r.add_argument("--k-agg", type=int, default=17)
 
     u = sub.add_parser("utils", help="deployment utilities")
     u.add_argument("util", choices=["committee-poseidon"])
@@ -64,7 +71,8 @@ def main(argv=None):
         print(f"loading prover state (spec={spec.name}, backend={args.backend})...",
               flush=True)
         state = ProverState(spec, args.k_step, args.k_committee,
-                            args.concurrency, args.backend)
+                            args.concurrency, args.backend,
+                            compress=args.compress, k_agg=args.k_agg)
         print(f"serving on {args.host}:{args.port}", flush=True)
         serve(state, args.host, args.port)
     elif args.cmd == "utils":
@@ -80,13 +88,15 @@ def _circuit_cmd(args, spec):
     from ..plonk.srs import SRS
     from ..witness import default_committee_update_args, default_sync_step_args
 
-    circuit = StepCircuit if args.which == "sync-step" else CommitteeUpdateCircuit
-    default_args = (default_sync_step_args if args.which == "sync-step"
+    compressed = args.which.endswith("-compressed")
+    base = args.which.removesuffix("-compressed")
+    circuit = StepCircuit if base == "sync-step" else CommitteeUpdateCircuit
+    default_args = (default_sync_step_args if base == "sync-step"
                     else default_committee_update_args)(spec)
     bk = B.get_backend(args.backend)
     srs = SRS.load_or_setup(args.k)
 
-    if args.action == "setup":
+    if args.action == "setup" and not compressed:
         pk = circuit.create_pk(srs, spec, args.k, default_args, bk)
         print(f"pk ready: {circuit.pinning_path(spec, args.k)}")
         return
@@ -95,9 +105,14 @@ def _circuit_cmd(args, spec):
     if args.witness:
         with open(args.witness) as f:
             data = json.load(f)
-        witness_args = _witness_from_json(args.which, data)
+        witness_args = _witness_from_json(base, data)
 
     pk = circuit.create_pk(srs, spec, args.k, default_args, bk)
+
+    if compressed:
+        _compressed_circuit_cmd(args, spec, circuit, pk, srs,
+                                default_args, witness_args, bk)
+        return
 
     if args.action == "gen-verifier":
         # reference: `spectre-prover circuit ... gen-verifier`
@@ -126,6 +141,72 @@ def _circuit_cmd(args, spec):
             proof = f.read()
         instances = circuit.get_instances(witness_args, spec)
         ok = circuit.verify(pk.vk, srs, instances, proof)
+        print(json.dumps({"valid": bool(ok)}))
+        sys.exit(0 if ok else 1)
+
+
+def _compressed_circuit_cmd(args, spec, circuit, pk, srs, default_args,
+                            witness_args, bk):
+    """Two-stage lifecycle (reference: `sync-step-compressed` CLI paths):
+    app snark (Poseidon transcript) -> aggregation circuit -> outer proof
+    (Keccak for the EVM calldata path)."""
+    from ..models import AggregationArgs, AggregationCircuit
+    from ..plonk.srs import SRS
+    from ..plonk.transcript import KeccakTranscript, PoseidonTranscript
+
+    agg_cls = AggregationCircuit.variant(circuit.name)
+    srs_agg = SRS.load_or_setup(args.k_agg)
+
+    def agg_args_for(wargs):
+        proof = circuit.prove(pk, srs, wargs, spec, bk,
+                              transcript=PoseidonTranscript())
+        inst = circuit.get_instances(wargs, spec)
+        return AggregationArgs(inner_vk=pk.vk, srs=srs,
+                               inner_instances=[inst], proof=proof)
+
+    agg_pk = agg_cls.create_pk(srs_agg, spec, args.k_agg,
+                               lambda: agg_args_for(default_args), bk)
+    if args.action == "setup":
+        print(f"pk ready: {agg_cls.pinning_path(spec, args.k_agg)}")
+        return
+    if args.action == "gen-verifier":
+        from ..evm import gen_evm_verifier
+        from ..models.app_circuit import BUILD_DIR
+        # statement = 12 accumulator limbs + the app instances (no proving
+        # needed to size it)
+        n_inst = 12 + len(circuit.get_instances(default_args, spec))
+        src = gen_evm_verifier(agg_pk.vk, srs_agg, num_instances=n_inst,
+                               contract_name=f"Verifier_{agg_cls.name}")
+        out = args.sol_out or os.path.join(
+            BUILD_DIR, f"{agg_cls.name}_{spec.name}_{args.k_agg}_verifier.sol")
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        with open(out, "w") as f:
+            f.write(src)
+        print(json.dumps({"verifier": out, "bytes": len(src)}))
+        return
+    inst_path = args.proof_out + ".instances.json"
+    if args.action == "prove":
+        agg_args = agg_args_for(witness_args)
+        proof = agg_cls.prove(agg_pk, srs_agg, agg_args, spec, bk,
+                              transcript=KeccakTranscript())
+        instances = AggregationCircuit.get_instances(agg_args, spec)
+        with open(args.proof_out, "wb") as f:
+            f.write(proof)
+        # the statement binds the (blinded, non-reproducible) app proof:
+        # persist it next to the outer proof for later verification
+        with open(inst_path, "w") as f:
+            json.dump({"instances": [hex(v) for v in instances]}, f)
+        print(json.dumps({"proof": args.proof_out, "bytes": len(proof),
+                          "instances": inst_path}))
+    elif args.action == "verify":
+        with open(args.proof_in or args.proof_out, "rb") as f:
+            proof = f.read()
+        src_path = ((args.proof_in or args.proof_out)
+                    + ".instances.json")
+        with open(src_path) as f:
+            instances = [int(v, 16) for v in json.load(f)["instances"]]
+        ok = agg_cls.verify(agg_pk.vk, srs_agg, instances, proof,
+                            transcript_cls=KeccakTranscript)
         print(json.dumps({"valid": bool(ok)}))
         sys.exit(0 if ok else 1)
 

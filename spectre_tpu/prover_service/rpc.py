@@ -71,11 +71,14 @@ class _Handler(BaseHTTPRequestHandler):
                 args = rotation_args_from_update(
                     params["light_client_update"], self.state.spec)
                 proof, instances = self.state.prove_committee(args)
+                # compressed layout: 12 accumulator limbs then app instances,
+                # poseidon at [12] (reference: rpc.rs:106 `instances[0][12]`)
+                pos_idx = 12 if self.state.compress else 0
                 result = {
                     "proof": "0x" + proof.hex(),
                     "instances": [hex(v) for v in instances],
                     "calldata": "0x" + encode_calldata(instances, proof).hex(),
-                    "committee_poseidon": hex(instances[0]),
+                    "committee_poseidon": hex(instances[pos_idx]),
                 }
             elif method == "ping":
                 result = "pong"

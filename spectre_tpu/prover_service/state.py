@@ -20,7 +20,13 @@ from ..witness import default_committee_update_args, default_sync_step_args
 class ProverState:
     def __init__(self, spec, k_step: int, k_committee: int,
                  concurrency: int = 1, backend: str = "cpu",
-                 params_dir: str | None = None):
+                 params_dir: str | None = None, compress: bool = False,
+                 k_agg: int = 17):
+        """compress: run the full two-stage flow (app snark with Poseidon
+        transcript -> in-circuit verification in the aggregation circuit ->
+        Keccak-transcript outer proof), the reference's `*Compressed` RPC
+        semantics. Boot additionally creates the two aggregation pkeys from
+        dummy app snarks (`cli.rs:241-280`'s dummy-proof-at-setup)."""
         self.spec = spec
         self.backend = B.get_backend(backend)
         self.semaphore = threading.Semaphore(concurrency)
@@ -34,15 +40,70 @@ class ProverState:
         self.committee_pk = CommitteeUpdateCircuit.create_pk(
             self.srs[k_committee], spec, k_committee,
             default_committee_update_args(spec), self.backend)
+        self.compress = compress
+        if compress:
+            from ..models import AggregationArgs, AggregationCircuit
+            from ..plonk.transcript import PoseidonTranscript
+            self.k_agg = k_agg
+            self.srs[k_agg] = SRS.load_or_setup(k_agg, params_dir)
+            self.step_agg = AggregationCircuit.variant("sync_step")
+            self.committee_agg = AggregationCircuit.variant("committee_update")
+            # lazy thunks: a dummy inner proof is only generated when the
+            # aggregation pk is not already cached
+            self.step_agg_pk = self.step_agg.create_pk(
+                self.srs[k_agg], spec, k_agg,
+                lambda: self._dummy_agg_args(StepCircuit, self.step_pk,
+                                             self.k_step,
+                                             default_sync_step_args(spec)),
+                self.backend)
+            self.committee_agg_pk = self.committee_agg.create_pk(
+                self.srs[k_agg], spec, k_agg,
+                lambda: self._dummy_agg_args(CommitteeUpdateCircuit,
+                                             self.committee_pk,
+                                             self.k_committee,
+                                             default_committee_update_args(spec)),
+                self.backend)
+
+    def _dummy_agg_args(self, circuit, pk, k, dummy_args):
+        from ..models import AggregationArgs
+        from ..plonk.transcript import PoseidonTranscript
+        proof = circuit.prove(pk, self.srs[k], dummy_args, self.spec,
+                              self.backend, transcript=PoseidonTranscript())
+        inst = circuit.get_instances(dummy_args, self.spec)
+        return AggregationArgs(inner_vk=pk.vk, srs=self.srs[k],
+                               inner_instances=[inst], proof=proof)
+
+    def _compressed(self, circuit, pk, k, agg_cls, agg_pk, args):
+        from ..models import AggregationArgs, AggregationCircuit
+        from ..plonk.transcript import KeccakTranscript, PoseidonTranscript
+        app_proof = circuit.prove(pk, self.srs[k], args, self.spec,
+                                  self.backend,
+                                  transcript=PoseidonTranscript())
+        inst = circuit.get_instances(args, self.spec)
+        agg_args = AggregationArgs(inner_vk=pk.vk, srs=self.srs[k],
+                                   inner_instances=[inst], proof=app_proof)
+        outer = agg_cls.prove(agg_pk, self.srs[self.k_agg], agg_args,
+                              self.spec, self.backend,
+                              transcript=KeccakTranscript())
+        return outer, AggregationCircuit.get_instances(agg_args, self.spec)
 
     def prove_step(self, args) -> tuple[bytes, list]:
         with self.semaphore:
+            if self.compress:
+                return self._compressed(StepCircuit, self.step_pk,
+                                        self.k_step, self.step_agg,
+                                        self.step_agg_pk, args)
             proof = StepCircuit.prove(self.step_pk, self.srs[self.k_step],
                                       args, self.spec, self.backend)
         return proof, StepCircuit.get_instances(args, self.spec)
 
     def prove_committee(self, args) -> tuple[bytes, list]:
         with self.semaphore:
+            if self.compress:
+                return self._compressed(CommitteeUpdateCircuit,
+                                        self.committee_pk, self.k_committee,
+                                        self.committee_agg,
+                                        self.committee_agg_pk, args)
             proof = CommitteeUpdateCircuit.prove(
                 self.committee_pk, self.srs[self.k_committee], args,
                 self.spec, self.backend)
