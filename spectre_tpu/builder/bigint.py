@@ -75,16 +75,17 @@ class BigUintChip:
         assert max_bits <= self.num_limbs * self.limb_bits, \
             "value exceeds limb capacity — pick a wider num_limbs/limb_bits"
         assert value < (1 << max_bits)
-        limbs = []
-        for i in range(self.num_limbs):
-            lv = (value >> (self.limb_bits * i)) & (self.base - 1)
-            limb = ctx.load_witness(lv)
+        limb_vals = [(value >> (self.limb_bits * i)) & (self.base - 1)
+                     for i in range(self.num_limbs)]
+        start = ctx.bulk_cells(limb_vals)
+        limbs = [AssignedValue("adv", start + i, v)
+                 for i, v in enumerate(limb_vals)]
+        for i, limb in enumerate(limbs):
             bits = min(self.limb_bits, max(max_bits - self.limb_bits * i, 0))
             if bits == 0:
                 ctx.constrain_constant(limb, 0)
             else:
                 self.rng.range_check(ctx, limb, bits)
-            limbs.append(limb)
         native = self.gate.inner_product_const(
             ctx, limbs, self._pow_native[:self.num_limbs])
         return CrtUint(limbs, native, value)
@@ -175,30 +176,19 @@ class BigUintChip:
 
     def add_ovf(self, ctx: Context, x: OverflowInt, y: OverflowInt) -> OverflowInt:
         gate = self.gate
-        n = max(len(x.limbs), len(y.limbs))
-        limbs = []
-        for k in range(n):
-            if k >= len(x.limbs):
-                limbs.append(y.limbs[k])
-            elif k >= len(y.limbs):
-                limbs.append(x.limbs[k])
-            else:
-                limbs.append(gate.add(ctx, x.limbs[k], y.limbs[k]))
+        nc = min(len(x.limbs), len(y.limbs))
+        added = gate.add_pairs(ctx, zip(x.limbs[:nc], y.limbs[:nc]))
+        limbs = added + x.limbs[nc:] + y.limbs[nc:]
         return OverflowInt(limbs, x.value + y.value,
                            x.limb_abs + y.limb_abs, x.val_abs + y.val_abs)
 
     def sub_ovf(self, ctx: Context, x: OverflowInt, y: OverflowInt) -> OverflowInt:
         gate = self.gate
-        n = max(len(x.limbs), len(y.limbs))
-        limbs = []
-        for k in range(n):
-            if k >= len(x.limbs):
-                limbs.append(gate.neg(ctx, y.limbs[k]))
-            elif k >= len(y.limbs):
-                limbs.append(x.limbs[k])
-            else:
-                limbs.append(gate.sub(ctx, x.limbs[k], y.limbs[k]))
-        return OverflowInt(limbs, x.value - y.value,
+        nc = min(len(x.limbs), len(y.limbs))
+        subbed = gate.sub_pairs(ctx, zip(x.limbs[:nc], y.limbs[:nc]))
+        tail = (x.limbs[nc:] if len(x.limbs) >= len(y.limbs)
+                else gate.sub_pairs(ctx, ((0, l) for l in y.limbs[nc:])))
+        return OverflowInt(subbed + tail, x.value - y.value,
                            x.limb_abs + y.limb_abs, x.val_abs + y.val_abs)
 
     def scale_ovf(self, ctx: Context, x: OverflowInt, c: int) -> OverflowInt:
@@ -279,15 +269,14 @@ class BigUintChip:
         # no mod-R wraparound in the chain: t + carry + offset*BASE must
         # stay far below R
         assert carry_bits + 2 + LIMB_BITS < 250, "overflow limbs too wide"
-        t_cells, t_vals = [], []
-        for k in range(ntot):
-            tv = _signed(_val_of(limbs[k])) - _signed(_val_of(qp_limbs[k]))
-            tc = gate.sub(ctx, limbs[k], qp_limbs[k])
-            if r is not None and k < NUM_LIMBS:
-                tv -= r.limbs[k].value
-                tc = gate.sub(ctx, tc, r.limbs[k])
-            t_cells.append(tc)
-            t_vals.append(tv)
+        t_vals = [_signed(_val_of(limbs[k])) - _signed(_val_of(qp_limbs[k]))
+                  for k in range(ntot)]
+        t_cells = gate.sub_pairs(ctx, zip(limbs, qp_limbs))
+        if r is not None:
+            for k in range(NUM_LIMBS):
+                t_vals[k] -= r.limbs[k].value
+            t_cells[:NUM_LIMBS] = gate.sub_pairs(
+                ctx, zip(t_cells[:NUM_LIMBS], r.limbs))
         self._carry_chain_zero(ctx, t_cells, t_vals, carry_bits=carry_bits)
         return r
 
@@ -312,16 +301,12 @@ class BigUintChip:
         #     t_k = X_k - (qp)_k - r_k ;  t_k + c_{k-1} = c_k * 2^LIMB_BITS
         # carries are signed; witness c_k + OFFSET to range-check unsigned.
         nlimbs_tot = 2 * NUM_LIMBS - 1
-        t_cells, t_vals = [], []
-        for k in range(nlimbs_tot):
-            xv = _val_of(prod_limbs[k])
-            qv = _val_of(qp_limbs[k])
-            rv = r.limbs[k].value if k < NUM_LIMBS else 0
-            t_vals.append(_signed(xv) - _signed(qv) - rv)
-            t_cell = gate.sub(ctx, prod_limbs[k], qp_limbs[k])
-            if k < NUM_LIMBS:
-                t_cell = gate.sub(ctx, t_cell, r.limbs[k])
-            t_cells.append(t_cell)
+        t_vals = [_signed(_val_of(prod_limbs[k])) - _signed(_val_of(qp_limbs[k]))
+                  - (r.limbs[k].value if k < NUM_LIMBS else 0)
+                  for k in range(nlimbs_tot)]
+        t_cells = gate.sub_pairs(ctx, zip(prod_limbs, qp_limbs))
+        t_cells[:NUM_LIMBS] = gate.sub_pairs(
+            ctx, zip(t_cells[:NUM_LIMBS], r.limbs))
         self._carry_chain_zero(ctx, t_cells, t_vals)
         return r
 
@@ -356,33 +341,69 @@ class BigUintChip:
                           carry_bits: int | None = None):
         """Constrain sum_k t_k * BASE^k == 0 over the integers, given limb
         cells t_k with |t_k| < ~2^(LIMB_BITS + carry_bits). Carries are signed;
-        each is witnessed with an offset so a single unsigned range check
-        bounds it."""
-        gate = self.gate
+        each is witnessed as c_k = carry_k + offset so a single unsigned range
+        check bounds it, and each chain link is ONE fused gate unit:
+          k=0:  t_0 + offset*BASE - c_0*BASE == 0
+          k>0:  (t_k + c_{k-1}) + (offset*BASE - offset) - c_k*BASE == 0
+        (the k>0 sum takes one extra add unit), with the final carry pinned
+        via c_last == offset."""
         BASE = self.base
         if carry_bits is None:
             carry_bits = self.limb_bits + self.num_limbs.bit_length() + 2
         offset = 1 << (carry_bits + 1)
-        carry_prev = None
+        # witness all carry cells upfront (one splittable record)
+        c_vals = []
         carry_prev_val = 0
-        for k in range(len(t_cells)):
-            t_cell = t_cells[k]
-            if carry_prev is not None:
-                t_cell = gate.add(ctx, t_cell, carry_prev)
-            total = t_vals[k] + carry_prev_val
+        for tv in t_vals:
+            total = tv + carry_prev_val
             assert total % BASE == 0, "carry chain misaligned"
             c_val = total // BASE
             assert abs(c_val) < offset
-            c = ctx.load_witness((c_val + offset) % R)
-            self.rng.range_check(ctx, c, carry_bits + 2)
-            # t_cell == (c - offset) * BASE  <=>  t_cell + offset*BASE == c*BASE
-            shifted = gate.add(ctx, t_cell, (offset * BASE) % R)
-            recomb = gate.mul(ctx, c, BASE)
-            ctx.constrain_equal(shifted, recomb)
-            carry_prev = gate.sub(ctx, c, offset)
+            c_vals.append(c_val + offset)
             carry_prev_val = c_val
-        # final carry must be zero
-        ctx.constrain_constant(carry_prev, 0)
+        cstart = ctx.bulk_cells(c_vals)
+        c_cells = [AssignedValue("adv", cstart + i, v)
+                   for i, v in enumerate(c_vals)]
+        for c in c_cells:
+            self.rng.range_check(ctx, c, carry_bits + 2)
+        # fused chain links
+        copies = ctx.copies
+        pin = ctx.pin_const
+        pos = len(ctx.adv_values)
+        flat = []
+        neg_base = (-BASE) % R
+        k0_const = (offset * BASE) % R
+        kk_const = (offset * BASE - offset) % R
+        neg_kk = (offset - offset * BASE) % R
+        for k, (t, cv) in enumerate(zip(t_cells, c_vals)):
+            if k == 0:
+                # [t_0, c_0, -BASE, -(offset*BASE)]: t0 + c0*(-BASE) + oB == 0
+                copies.append((("adv", t.index), ("adv", pos)))
+                copies.append((("adv", cstart), ("adv", pos + 1)))
+                pin(pos + 2, neg_base)
+                pin(pos + 3, (-k0_const) % R)
+                flat.append(t.value), flat.append(cv), flat.append(neg_base), \
+                    flat.append((-k0_const) % R)
+                pos += 4
+            else:
+                # s = t_k + c_{k-1}
+                sv = (t.value + c_vals[k - 1]) % R
+                copies.append((("adv", t.index), ("adv", pos)))
+                copies.append((("adv", cstart + k - 1), ("adv", pos + 1)))
+                pin(pos + 2, 1)
+                flat.append(t.value), flat.append(c_vals[k - 1]), \
+                    flat.append(1), flat.append(sv)
+                # [s, c_k, -BASE, -(oB - offset)]: s + kk_const - c_k*BASE == 0
+                copies.append((("adv", pos + 3), ("adv", pos + 4)))
+                copies.append((("adv", cstart + k), ("adv", pos + 5)))
+                pin(pos + 6, neg_base)
+                pin(pos + 7, neg_kk)
+                flat.append(sv), flat.append(cv), flat.append(neg_base), \
+                    flat.append(neg_kk)
+                pos += 8
+        ctx.bulk_gated(flat)
+        # final carry must be zero: c_last == offset
+        ctx.constrain_constant(c_cells[-1], offset % R)
 
     def check_carry_to_zero(self, ctx: Context, prod_limbs: list,
                             prod_value: int, p: int):
@@ -397,11 +418,9 @@ class BigUintChip:
         q = self.load(ctx, q_val, max_bits=p.bit_length() + 8)
         qp_limbs = self._qp_identity(ctx, q, p)
         self._native_zero(ctx, prod_limbs, qp_limbs, None)
-        t_cells, t_vals = [], []
-        for k in range(2 * self.num_limbs - 1):
-            t_vals.append(_signed(_val_of(prod_limbs[k])) -
-                          _signed(_val_of(qp_limbs[k])))
-            t_cells.append(gate.sub(ctx, prod_limbs[k], qp_limbs[k]))
+        t_vals = [_signed(_val_of(prod_limbs[k])) - _signed(_val_of(qp_limbs[k]))
+                  for k in range(2 * self.num_limbs - 1)]
+        t_cells = gate.sub_pairs(ctx, zip(prod_limbs, qp_limbs))
         self._carry_chain_zero(ctx, t_cells, t_vals)
 
     def enforce_lt(self, ctx: Context, a: CrtUint, bound: int):

@@ -120,6 +120,44 @@ class Context:
             i += 1
         return AssignedValue("adv", start + out_i, adv[start + out_i])
 
+    # -- bulk primitives (vectorized witness generation) ----------------
+    # Death-by-a-thousand-cuts fix: per-op Python call overhead dominated
+    # witness-gen profiles (~18us/gate unit), so hot chips build value lists
+    # in tight loops and append through these. Constraint semantics are
+    # IDENTICAL to the per-op paths — only the append mechanics change.
+
+    def bulk_cells(self, vals: list[int]) -> int:
+        """Append ungated witness cells as ONE splittable unit record.
+        vals must already be reduced mod R. Returns the start index."""
+        start = len(self.adv_values)
+        self.adv_values.extend(vals)
+        self.adv_units.append((start, len(vals), False))
+        return start
+
+    def bulk_gated(self, flat_vals: list[int]) -> int:
+        """Append len(flat_vals)//4 gated 4-cell units (values reduced mod R).
+        Returns the start index; callers register copies/pins themselves."""
+        start = len(self.adv_values)
+        self.adv_values.extend(flat_vals)
+        self.adv_units.extend(
+            (start + i, 4, True) for i in range(0, len(flat_vals), 4))
+        return start
+
+    def bulk_lookup(self, table: str, idx_val_pairs) -> None:
+        """Push (adv index, value) pairs into a lookup table stream."""
+        stream = self.lkp_streams.setdefault(table, [])
+        base = len(stream)
+        copies = self.copies
+        key = ("lkp", table)
+        for j, (i, v) in enumerate(idx_val_pairs):
+            stream.append(v)
+            copies.append((("adv", i), (key, base + j)))
+
+    def pin_const(self, adv_idx: int, v: int) -> None:
+        """Constant-pin an advice cell by index (value already reduced)."""
+        row = self.constants.setdefault(v, len(self.constants))
+        self.const_uses.append((adv_idx, row))
+
     def push_lookup(self, av: AssignedValue) -> None:
         """Copy a cell into the range-table lookup stream."""
         self.push_lookup_table(av, "range")
@@ -190,17 +228,46 @@ class Context:
         col, row = 0, 0
         break_points = []
         for start, size, gated in self.adv_units:
-            if row + size > u:
-                break_points.append(row)
-                col += 1
-                row = 0
-                assert col < cfg.num_advice, "advice overflow: raise k or columns"
-            for i in range(size):
-                advice[col][row + i] = self.adv_values[start + i]
-                placement[start + i] = (col, row + i)
             if gated:
-                selectors[col][row] = 1
-            row += size
+                # gated units are a vertical-gate activation over 4 consecutive
+                # rows (or a sequence of such for bulk records): each 4-block
+                # must stay contiguous within a column
+                for off in range(0, size, 4):
+                    if row + 4 > u:
+                        break_points.append(row)
+                        col += 1
+                        row = 0
+                        assert col < cfg.num_advice, \
+                            "advice overflow: raise k or columns"
+                    acol, s = advice[col], start + off
+                    acol[row] = self.adv_values[s]
+                    acol[row + 1] = self.adv_values[s + 1]
+                    acol[row + 2] = self.adv_values[s + 2]
+                    acol[row + 3] = self.adv_values[s + 3]
+                    placement[s] = (col, row)
+                    placement[s + 1] = (col, row + 1)
+                    placement[s + 2] = (col, row + 2)
+                    placement[s + 3] = (col, row + 3)
+                    selectors[col][row] = 1
+                    row += 4
+            else:
+                # ungated cells carry no relative-rotation constraint: split
+                # freely across column boundaries
+                done = 0
+                while done < size:
+                    if row >= u:
+                        break_points.append(row)
+                        col += 1
+                        row = 0
+                        assert col < cfg.num_advice, \
+                            "advice overflow: raise k or columns"
+                    take = min(size - done, u - row)
+                    acol = advice[col]
+                    for i in range(take):
+                        acol[row + i] = self.adv_values[start + done + i]
+                        placement[start + done + i] = (col, row + i)
+                    done += take
+                    row += take
         break_points.append(row)
 
         lookup = [[0] * n for _ in range(cfg.num_lookup_advice)]

@@ -109,20 +109,128 @@ class GateChip:
         return acc if acc is not None else ctx.load_zero()
 
     def inner_product(self, ctx: Context, a_vals, b_vals) -> AssignedValue:
-        """sum a_i * b_i as a mul_add chain."""
+        """sum a_i * b_i as a mul_add chain (bulk-appended: [c, a, b, out]
+        units where c chains the previous out; first unit is a bare mul)."""
         assert len(a_vals) == len(b_vals) and a_vals
-        acc = self.mul(ctx, a_vals[0], b_vals[0])
-        for x, y in zip(a_vals[1:], b_vals[1:]):
-            acc = self.mul_add(ctx, x, y, acc)
-        return acc
+        adv = ctx.adv_values
+        copies = ctx.copies
+        start = len(adv)
+        pos = start
+        flat = []
+        acc = 0
+        first = True
+        for x, y in zip(a_vals, b_vals):
+            if x.__class__ is AssignedValue:
+                xv = x.value
+                copies.append((("adv", x.index), ("adv", pos + 1)))
+            else:
+                xv = int(x) % R
+                ctx.pin_const(pos + 1, xv)
+            if y.__class__ is AssignedValue:
+                yv = y.value
+                copies.append((("adv", y.index), ("adv", pos + 2)))
+            else:
+                yv = int(y) % R
+                ctx.pin_const(pos + 2, yv)
+            if first:
+                ctx.pin_const(pos, 0)
+                first = False
+            else:
+                copies.append((("adv", pos - 1), ("adv", pos)))
+            out = (acc + xv * yv) % R
+            flat.append(acc), flat.append(xv), flat.append(yv), flat.append(out)
+            acc = out
+            pos += 4
+        ctx.bulk_gated(flat)
+        return AssignedValue("adv", pos - 1, acc)
 
     def inner_product_const(self, ctx: Context, vals, consts) -> AssignedValue:
-        """sum vals_i * c_i with host constants c_i."""
+        """sum vals_i * c_i with host constants c_i (bulk-appended chain)."""
         assert len(vals) == len(consts) and vals
-        acc = self.mul(ctx, vals[0], int(consts[0]) % R)
-        for x, cst in zip(vals[1:], consts[1:]):
-            acc = self.mul_add(ctx, x, int(cst) % R, acc)
-        return acc
+        adv = ctx.adv_values
+        copies = ctx.copies
+        start = len(adv)
+        pos = start
+        flat = []
+        acc = 0
+        first = True
+        for x, cst in zip(vals, consts):
+            c = int(cst) % R
+            if x.__class__ is AssignedValue:
+                xv = x.value
+                copies.append((("adv", x.index), ("adv", pos + 1)))
+            else:
+                xv = int(x) % R
+                ctx.pin_const(pos + 1, xv)
+            ctx.pin_const(pos + 2, c)
+            if first:
+                ctx.pin_const(pos, 0)
+                first = False
+            else:
+                copies.append((("adv", pos - 1), ("adv", pos)))
+            out = (acc + xv * c) % R
+            flat.append(acc), flat.append(xv), flat.append(c), flat.append(out)
+            acc = out
+            pos += 4
+        ctx.bulk_gated(flat)
+        return AssignedValue("adv", pos - 1, acc)
+
+    def add_pairs(self, ctx: Context, pairs) -> list:
+        """Elementwise a+b over (a, b) pairs, bulk-appended [a, b, 1, out]
+        units (identical constraints to add())."""
+        copies = ctx.copies
+        pin = ctx.pin_const
+        pos = len(ctx.adv_values)
+        flat = []
+        outs = []
+        for a, b in pairs:
+            if a.__class__ is AssignedValue:
+                av = a.value
+                copies.append((("adv", a.index), ("adv", pos)))
+            else:
+                av = int(a) % R
+                pin(pos, av)
+            if b.__class__ is AssignedValue:
+                bv = b.value
+                copies.append((("adv", b.index), ("adv", pos + 1)))
+            else:
+                bv = int(b) % R
+                pin(pos + 1, bv)
+            pin(pos + 2, 1)
+            out = (av + bv) % R
+            flat.append(av), flat.append(bv), flat.append(1), flat.append(out)
+            outs.append(AssignedValue("adv", pos + 3, out))
+            pos += 4
+        ctx.bulk_gated(flat)
+        return outs
+
+    def sub_pairs(self, ctx: Context, pairs) -> list:
+        """Elementwise a-b over (a, b) pairs, bulk-appended [out, b, 1, a]
+        units (identical constraints to sub())."""
+        copies = ctx.copies
+        pin = ctx.pin_const
+        pos = len(ctx.adv_values)
+        flat = []
+        outs = []
+        for a, b in pairs:
+            av = a.value if a.__class__ is AssignedValue else int(a) % R
+            if b.__class__ is AssignedValue:
+                bv = b.value
+                copies.append((("adv", b.index), ("adv", pos + 1)))
+            else:
+                bv = int(b) % R
+                pin(pos + 1, bv)
+            pin(pos + 2, 1)
+            if a.__class__ is AssignedValue:
+                copies.append((("adv", a.index), ("adv", pos + 3)))
+            else:
+                pin(pos + 3, av)
+            out = (av - bv) % R
+            flat.append(out), flat.append(bv), flat.append(1), flat.append(av)
+            outs.append(AssignedValue("adv", pos, out))
+            pos += 4
+        ctx.bulk_gated(flat)
+        return outs
 
     def num_to_bits(self, ctx: Context, a: AssignedValue, nbits: int) -> list:
         """Little-endian bit decomposition, each bit boolean-constrained and

@@ -20,18 +20,20 @@ class RangeChip:
         self.gate = gate or GateChip()
 
     def range_check(self, ctx: Context, a: AssignedValue, nbits: int):
-        """Constrain 0 <= a < 2^nbits via lookup_bits-limb decomposition."""
+        """Constrain 0 <= a < 2^nbits via lookup_bits-limb decomposition
+        (bulk-appended: one splittable witness record + bulk lookup pushes)."""
         lb = self.lookup_bits
         av = a.value
         assert av < (1 << nbits), f"range_check witness {av} >= 2^{nbits}"
         nlimbs = (nbits + lb - 1) // lb
         rem = nbits - (nlimbs - 1) * lb      # bits of the top limb
-        limbs = []
-        for i in range(nlimbs):
-            lv = (av >> (lb * i)) & ((1 << lb) - 1)
-            limb = ctx.load_witness(lv)
-            ctx.push_lookup(limb)
-            limbs.append(limb)
+        mask = (1 << lb) - 1
+        limb_vals = [(av >> (lb * i)) & mask for i in range(nlimbs)]
+        start = ctx.bulk_cells(limb_vals)
+        ctx.bulk_lookup("range",
+                        [(start + i, v) for i, v in enumerate(limb_vals)])
+        limbs = [AssignedValue("adv", start + i, v)
+                 for i, v in enumerate(limb_vals)]
         # top limb tighter bound: limb * 2^(lb-rem) must also be in table
         if rem < lb:
             shifted = self.gate.mul(ctx, limbs[-1], 1 << (lb - rem))

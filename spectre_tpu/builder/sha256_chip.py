@@ -27,6 +27,8 @@ R = bn254.R
 XOR_OP = 0
 AND_OP = 1
 
+_POW16 = [1 << (4 * i) for i in range(8)]
+
 
 @dataclass
 class Word:
@@ -72,15 +74,17 @@ class Sha256Chip:
         ctx.push_lookup_table(x, "nibble")
 
     def _decompose(self, ctx: Context, cell: AssignedValue) -> list:
-        """cell (32-bit value) -> 8 checked nibbles, recomposition constrained."""
+        """cell (32-bit value) -> 8 checked nibbles, recomposition constrained
+        (bulk-appended)."""
         v = cell.value
         assert v < (1 << 32)
-        nibs = []
-        for i in range(8):
-            nib = ctx.load_witness((v >> (4 * i)) & 0xF)
-            self._check_nibble(ctx, nib)
-            nibs.append(nib)
-        acc = self.gate.inner_product_const(ctx, nibs, [1 << (4 * i) for i in range(8)])
+        nib_vals = [(v >> (4 * i)) & 0xF for i in range(8)]
+        start = ctx.bulk_cells(nib_vals)
+        ctx.bulk_lookup("nibble",
+                        [(start + i, nv) for i, nv in enumerate(nib_vals)])
+        nibs = [AssignedValue("adv", start + i, nv)
+                for i, nv in enumerate(nib_vals)]
+        acc = self.gate.inner_product_const(ctx, nibs, _POW16)
         ctx.constrain_equal(acc, cell)
         return nibs
 
@@ -109,9 +113,55 @@ class Sha256Chip:
 
     # -- bitwise ops ----------------------------------------------------
     def _nib_op(self, ctx: Context, op: int, a_nibs, b_nibs) -> list:
-        fn = (lambda x, y: x ^ y) if op == XOR_OP else (lambda x, y: x & y)
-        return [self._push_op(ctx, op, x, y, fn(x.value, y.value))
-                for x, y in zip(a_nibs, b_nibs)]
+        """Bulk form of `_push_op` over a nibble vector: identical constraint
+        structure (witness z, nibble-check z, pack (op,x,y,z), table lookup),
+        appended through the bulk primitives. Inputs must already be checked
+        nibbles (same soundness invariant as `_push_op`)."""
+        if op == XOR_OP:
+            z_vals = [x.value ^ y.value for x, y in zip(a_nibs, b_nibs)]
+        else:
+            z_vals = [x.value & y.value for x, y in zip(a_nibs, b_nibs)]
+        zstart = ctx.bulk_cells(z_vals)
+        ctx.bulk_lookup("nibble",
+                        [(zstart + i, zv) for i, zv in enumerate(z_vals)])
+        copies = ctx.copies
+        pin = ctx.pin_const
+        op_hi = op << 12
+        flat = []
+        lkp = []
+        pos = len(ctx.adv_values)
+        for i, (x, y) in enumerate(zip(a_nibs, b_nibs)):
+            assert x.value < 16 and y.value < 16, "unchecked nibble into _nib_op"
+            xv, yv, zv = x.value, y.value, z_vals[i]
+            t1 = yv * 16 + zv
+            # unit: t1 = y*16 + z  as  [z, y, 16, t1]
+            copies.append((("adv", zstart + i), ("adv", pos)))
+            copies.append((("adv", y.index), ("adv", pos + 1)))
+            pin(pos + 2, 16)
+            flat.append(zv), flat.append(yv), flat.append(16), flat.append(t1)
+            packed = xv * 256 + t1
+            # unit: packed = x*256 + t1  as  [t1, x, 256, packed]
+            copies.append((("adv", pos + 3), ("adv", pos + 4)))
+            copies.append((("adv", x.index), ("adv", pos + 5)))
+            pin(pos + 6, 256)
+            flat.append(t1), flat.append(xv), flat.append(256), flat.append(packed)
+            pos += 8
+            if op_hi:
+                # unit: out = packed + op<<12  as  [packed, op<<12, 1, out]
+                out = packed + op_hi
+                copies.append((("adv", pos - 1), ("adv", pos)))
+                pin(pos + 1, op_hi)
+                pin(pos + 2, 1)
+                flat.append(packed), flat.append(op_hi), flat.append(1), \
+                    flat.append(out)
+                pos += 4
+                lkp.append((pos - 1, out))
+            else:
+                lkp.append((pos - 1, packed))
+        ctx.bulk_gated(flat)
+        ctx.bulk_lookup("nibble_op", lkp)
+        return [AssignedValue("adv", zstart + i, zv)
+                for i, zv in enumerate(z_vals)]
 
     def xor3(self, ctx: Context, a_nibs, b_nibs, c_nibs) -> list:
         return self._nib_op(ctx, XOR_OP, self._nib_op(ctx, XOR_OP, a_nibs, b_nibs), c_nibs)
@@ -151,20 +201,22 @@ class Sha256Chip:
         return lo, hi
 
     def _range_bits(self, ctx: Context, cell: AssignedValue, bits: int):
-        """cell < 2^bits via nibble decomposition (+ shifted top nibble)."""
+        """cell < 2^bits via nibble decomposition (+ shifted top nibble),
+        bulk-appended."""
         v = cell.value
         assert v < (1 << bits)
         nn = (bits + 3) // 4
-        nibs = []
-        for i in range(nn):
-            nib = ctx.load_witness((v >> (4 * i)) & 0xF)
-            self._check_nibble(ctx, nib)
-            nibs.append(nib)
+        nib_vals = [(v >> (4 * i)) & 0xF for i in range(nn)]
+        start = ctx.bulk_cells(nib_vals)
+        ctx.bulk_lookup("nibble",
+                        [(start + i, nv) for i, nv in enumerate(nib_vals)])
+        nibs = [AssignedValue("adv", start + i, nv)
+                for i, nv in enumerate(nib_vals)]
         rem = bits - 4 * (nn - 1)
         if rem < 4:
             shifted = self.gate.mul(ctx, nibs[-1], 1 << (4 - rem))
             self._check_nibble(ctx, shifted)
-        acc = self.gate.inner_product_const(ctx, nibs, [1 << (4 * i) for i in range(nn)])
+        acc = self.gate.inner_product_const(ctx, nibs, _POW16[:nn])
         ctx.constrain_equal(acc, cell)
 
     def rotr(self, ctx: Context, w: Word, r: int) -> Word:

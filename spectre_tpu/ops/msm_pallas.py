@@ -1,0 +1,325 @@
+"""Pallas TPU kernel path for the Pippenger MSM (N2 north star).
+
+Why this exists: the jnp MSM (`ops/msm.py`) lowers every field op to its own
+XLA kernel with `lax.scan` carry chains — dozens of HBM round-trips per EC
+add. This module fuses one COMPLETE projective add (14 Montgomery muls +
+~20 field add/subs, RCB alg. 7) into a single Pallas kernel with all
+intermediates in VMEM/registers, and lays data out structure-of-arrays so the
+128-wide lanes run across POINTS (the batch) instead of across the 16 limbs
+(which wasted 7/8 of every VPU issue in the AoS layout).
+
+Layout: a point batch is [48, N] uint32 — rows = 3 projective coordinates x
+16 Montgomery 16-bit limbs, lanes = points. Kernel math mirrors
+`ops/field_ops.py` CIOS exactly (same magnitude analysis: accumulators stay
+< 2^24, so uint32 never overflows).
+
+Reference parity: halo2's `best_multiexp` (SURVEY.md §2b N2) — algorithmic
+redesign, no code lineage.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import field_ops as F
+
+NL = F.NLIMBS          # 16 limbs x 16 bits
+ROWS = 3 * NL          # SoA rows per point batch
+MASK16 = np.uint32(0xFFFF)
+LANE = 128
+
+_P_LIMBS = tuple(int(v) for v in F.fq_ctx().p_limbs)   # BN254 Fq
+_N0 = np.uint32(F.fq_ctx().n0inv16)
+
+_INTERPRET = False     # set True for CPU debugging of the kernel
+
+
+# ---------------------------------------------------------------------------
+# layout converters
+# ---------------------------------------------------------------------------
+
+def to_soa(points):
+    """[..., 3, 16] AoS -> [48, N] SoA (flattening leading dims)."""
+    a = points.reshape(-1, 3, NL)
+    return jnp.transpose(a, (1, 2, 0)).reshape(ROWS, a.shape[0])
+
+
+def from_soa(arr):
+    """[48, N] SoA -> [N, 3, 16] AoS."""
+    n = arr.shape[1]
+    return jnp.transpose(arr.reshape(3, NL, n), (2, 0, 1))
+
+
+def inf_soa(n: int):
+    """Projective infinity (0:1:0) as [48, n]."""
+    one = F.fq_ctx().one_mont
+    col = np.zeros((ROWS,), np.uint32)
+    col[NL:2 * NL] = one
+    return jnp.broadcast_to(jnp.asarray(col)[:, None], (ROWS, n))
+
+
+# ---------------------------------------------------------------------------
+# in-kernel field arithmetic over lists of [T] limb rows
+# ---------------------------------------------------------------------------
+
+def _k_mont_mul(a, b):
+    """CIOS Montgomery product of two 16-row limb lists (uint32 [T] rows)."""
+    zero = jnp.zeros_like(a[0])
+    t = [zero] * (NL + 1)
+    for j in range(NL):
+        bj = b[j]
+        for i in range(NL):
+            pr = a[i] * bj
+            t[i] = t[i] + (pr & MASK16)
+            t[i + 1] = t[i + 1] + (pr >> 16)
+        m = (t[0] * _N0) & MASK16
+        for i in range(NL):
+            q = m * np.uint32(_P_LIMBS[i])
+            t[i] = t[i] + (q & MASK16)
+            t[i + 1] = t[i + 1] + (q >> 16)
+        carry = t[0] >> 16
+        t = t[1:] + [zero]
+        t[0] = t[0] + carry
+    return _k_carry_sub(t[:NL])
+
+
+def _k_carry_sub(t):
+    """Full carry propagation then conditional subtract of p."""
+    out = []
+    carry = jnp.zeros_like(t[0])
+    for i in range(NL):
+        cur = t[i] + carry
+        out.append(cur & MASK16)
+        carry = cur >> 16
+    return _k_cond_sub_p(out)
+
+
+def _k_cond_sub_p(a):
+    """a if a < p else a - p (a < 2p, limbs normalized)."""
+    diff = []
+    borrow = jnp.zeros_like(a[0])
+    for i in range(NL):
+        cur = a[i] - np.uint32(_P_LIMBS[i]) - borrow
+        diff.append(cur & MASK16)
+        borrow = (cur >> 16) & np.uint32(1)
+    keep = borrow != 0
+    return [jnp.where(keep, x, d) for x, d in zip(a, diff)]
+
+
+def _k_add(a, b):
+    out = []
+    carry = jnp.zeros_like(a[0])
+    for i in range(NL):
+        cur = a[i] + b[i] + carry
+        out.append(cur & MASK16)
+        carry = cur >> 16
+    return _k_cond_sub_p(out)
+
+
+def _k_sub(a, b):
+    """a - b mod p via a + (p - b); both inputs reduced (p - 0 = p is
+    normalized by the add's conditional subtract)."""
+    pb = []
+    borrow = jnp.zeros_like(a[0])
+    for i in range(NL):
+        cur = np.uint32(_P_LIMBS[i]) - b[i] - borrow
+        pb.append(cur & MASK16)
+        borrow = (cur >> 16) & np.uint32(1)
+    return _k_add(a, pb)
+
+
+def _k_padd(p_rows, q_rows):
+    """Complete RCB (alg. 7, a=0, b3=9) add on two 48-row lists."""
+    x1, y1, z1 = p_rows[:NL], p_rows[NL:2 * NL], p_rows[2 * NL:]
+    x2, y2, z2 = q_rows[:NL], q_rows[NL:2 * NL], q_rows[2 * NL:]
+
+    t0 = _k_mont_mul(x1, x2)
+    t1 = _k_mont_mul(y1, y2)
+    t2 = _k_mont_mul(z1, z2)
+    m3 = _k_mont_mul(_k_add(x1, y1), _k_add(x2, y2))
+    m4 = _k_mont_mul(_k_add(y1, z1), _k_add(y2, z2))
+    m5 = _k_mont_mul(_k_add(x1, z1), _k_add(x2, z2))
+    t3 = _k_sub(_k_sub(m3, t0), t1)
+    t4 = _k_sub(_k_sub(m4, t1), t2)
+    ycross = _k_sub(_k_sub(m5, t0), t2)
+
+    t0_3 = _k_add(_k_add(t0, t0), t0)
+    t2_2 = _k_add(t2, t2)
+    t2_4 = _k_add(t2_2, t2_2)
+    b3t2 = _k_add(_k_add(t2_4, t2_4), t2)          # 9*t2
+    y_2 = _k_add(ycross, ycross)
+    y_4 = _k_add(y_2, y_2)
+    b3y = _k_add(_k_add(y_4, y_4), ycross)         # 9*ycross
+
+    z3p = _k_add(t1, b3t2)
+    t1m = _k_sub(t1, b3t2)
+
+    x3a = _k_mont_mul(t4, b3y)
+    x3b = _k_mont_mul(t3, t1m)
+    y3a = _k_mont_mul(b3y, t0_3)
+    y3b = _k_mont_mul(t1m, z3p)
+    z3a = _k_mont_mul(t0_3, t3)
+    z3b = _k_mont_mul(z3p, t4)
+
+    return (_k_sub(x3b, x3a) + _k_add(y3b, y3a) + _k_add(z3b, z3a))
+
+
+def _padd_kernel(p_ref, q_ref, o_ref):
+    p_rows = [p_ref[i, :] for i in range(ROWS)]
+    q_rows = [q_ref[i, :] for i in range(ROWS)]
+    out = _k_padd(p_rows, q_rows)
+    for i in range(ROWS):
+        o_ref[i, :] = out[i]
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def _padd_soa_call(p, q, block: int):
+    from jax.experimental import pallas as pl
+
+    n = p.shape[1]
+    grid = (n // block,)
+    return pl.pallas_call(
+        _padd_kernel,
+        out_shape=jax.ShapeDtypeStruct((ROWS, n), jnp.uint32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ROWS, block), lambda i: (0, i)),
+            pl.BlockSpec((ROWS, block), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((ROWS, block), lambda i: (0, i)),
+        interpret=_INTERPRET,
+    )(p, q)
+
+
+def padd_soa(p, q, block: int = 2048):
+    """Complete projective add on SoA batches [48, N]; pads lanes to a
+    multiple of 128 (padding lanes compute garbage and are sliced off)."""
+    n = p.shape[1]
+    n_pad = -(-n // LANE) * LANE
+    if n_pad != n:
+        pad = ((0, 0), (0, n_pad - n))
+        p = jnp.pad(p, pad)
+        q = jnp.pad(q, pad)
+    block = min(block, n_pad)
+    while n_pad % block:
+        block //= 2
+    out = _padd_soa_call(p, q, block)
+    return out[:, :n] if n_pad != n else out
+
+
+# ---------------------------------------------------------------------------
+# MSM on SoA arrays (segmented-reduction Pippenger, as ops/msm.py)
+# ---------------------------------------------------------------------------
+
+def _segmented_bucket_sums_soa(points, digits, nbuckets: int):
+    """points [48, n] (n a power of two), digits [n] in [0, nbuckets]
+    (nbuckets = sentinel/skip) -> [48, nbuckets] bucket sums.
+
+    Emission slots are laid out with stride nbuckets+1 per level: the last
+    slot of each level's block is the trash slot for non-emitting lanes
+    (sentinel pairs), discarded before the tree reduction."""
+    n = points.shape[1]
+    order = jnp.argsort(digits, stable=True)
+    buckets = digits[order]
+    pts = points[:, order]
+    levels = n.bit_length() - 1
+    stride = nbuckets + 1
+
+    emissions = inf_soa((levels + 1) * stride)
+    for lvl in range(levels):
+        left, right = pts[:, 0::2], pts[:, 1::2]
+        bl, br = buckets[0::2], buckets[1::2]
+        same = bl == br
+        merged = padd_soa(left, right)
+        pts = jnp.where(same[None, :], merged, right)
+        emit_idx = lvl * stride + jnp.where(same, nbuckets, bl)
+        emissions = emissions.at[:, emit_idx].set(left, mode="drop")
+        buckets = br
+    emissions = emissions.at[:, levels * stride + buckets[0]].set(
+        pts[:, 0], mode="drop")
+
+    # drop trash slots, tree-reduce over the level axis
+    acc = emissions.reshape(ROWS, levels + 1, stride)[:, :, :nbuckets]
+    k = levels + 1
+    while k > 1:
+        half = k // 2
+        merged = padd_soa(
+            acc[:, :half].reshape(ROWS, half * nbuckets),
+            acc[:, half:2 * half].reshape(ROWS, half * nbuckets),
+        ).reshape(ROWS, half, nbuckets)
+        acc = (jnp.concatenate([merged, acc[:, 2 * half:]], axis=1)
+               if k % 2 else merged)
+        k = acc.shape[1]
+    return acc[:, 0]
+
+
+def _aggregate_buckets_soa(bucket_sums, c: int):
+    """sum_b b * B_b: bucket_sums [48, nwin, nbuckets] -> [48, nwin].
+
+    High-to-low over digit bits: acc = 2*acc + sum(buckets with bit j set)."""
+    nwin, nbuckets = bucket_sums.shape[1], bucket_sums.shape[2]
+    idx = jnp.arange(nbuckets)
+    inf1 = inf_soa(1)[:, None, :]                      # [48, 1, 1]
+    acc = inf_soa(nwin)
+    for j in range(c - 1, -1, -1):
+        acc = padd_soa(acc, acc)
+        mask = ((idx >> j) & 1).astype(bool)
+        cur = jnp.where(mask[None, None, :], bucket_sums, inf1)
+        k = nbuckets
+        while k > 1:
+            half = k // 2
+            merged = padd_soa(
+                cur[:, :, :half].reshape(ROWS, nwin * half),
+                cur[:, :, half:2 * half].reshape(ROWS, nwin * half),
+            ).reshape(ROWS, nwin, half)
+            cur = (jnp.concatenate([merged, cur[:, :, 2 * half:]], axis=2)
+                   if k % 2 else merged)
+            k = cur.shape[2]
+        acc = padd_soa(acc, cur[:, :, 0])
+    return acc
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def msm_windows_soa(points, scalars, c: int):
+    """Per-window partial MSM sums: points [48, n] SoA Montgomery, scalars
+    [n, 16] standard-form 16-bit limbs -> [48, nwin]."""
+    from . import msm as MSM
+
+    nwin = (254 + c - 1) // c
+    nbuckets = 1 << c
+    n = points.shape[1]
+    n_pad = max(1 << ((n - 1).bit_length() if n > 1 else 1), LANE)
+    if n_pad != n:
+        points = jnp.concatenate([points, inf_soa(n_pad - n)], axis=1)
+
+    def one_window(w):
+        d = MSM._digits_traced(scalars, w, c)
+        if n_pad != n:
+            d = jnp.concatenate(
+                [d, jnp.full((n_pad - n,), nbuckets, dtype=d.dtype)])
+        return _segmented_bucket_sums_soa(points, d, nbuckets)
+
+    sums = jax.lax.map(one_window, jnp.arange(nwin))     # [nwin, 48, nb]
+    return _aggregate_buckets_soa(jnp.transpose(sums, (1, 0, 2)), c)
+
+
+def combine_windows_soa(window_sums, c: int):
+    """[48, nwin] -> affine host result via the AoS combine (tiny workload:
+    c doublings + 1 add per window — not worth a kernel)."""
+    from . import msm as MSM
+
+    return MSM.combine_windows(from_soa(window_sums), c)
+
+
+def msm_soa(points, scalars, c: int | None = None):
+    """Full MSM: points [48, n] SoA Montgomery, scalars [n, 16] standard
+    limbs. Returns [3, 16] projective Montgomery (AoS, as ops/msm.msm)."""
+    n = points.shape[1]
+    if c is None:
+        from . import msm as MSM
+        c = MSM.default_window(n)
+    return combine_windows_soa(msm_windows_soa(points, scalars, c), c)

@@ -104,25 +104,35 @@ def mock_prove(cfg: CircuitConfig, assignment: Assignment, fixed_values=None,
                                     for j in range(cfg.num_lookup_advice)]
 
     # --- direct checks first (better error messages than the polynomial ones) ---
+    # flat per-column value lists: the per-cell closure version cost ~2us/cell
+    # and dominated mock wall-clock on megacell circuits
     keys = perm_column_keys(cfg)
-
-    def cell(col_idx, row):
-        kind, j = keys[col_idx]
-        src = {"adv": assignment.advice, "ladv": assignment.lookup_advice,
-               "fix": fixed_values}.get(kind)
-        if kind == "inst":
-            return assignment.instance_column(j)[row]
-        return int(src[j][row]) % R
+    colv = []
+    for kind, j in keys:
+        if kind == "adv":
+            colv.append(assignment.advice[j])
+        elif kind == "ladv":
+            colv.append(assignment.lookup_advice[j])
+        elif kind == "fix":
+            colv.append(fixed_values[j])
+        else:
+            colv.append(assignment.instance_column(j))
 
     for (ca, ra), (cb, rb) in assignment.copies:
-        va, vb = cell(ca, ra), cell(cb, rb)
-        assert va == vb, f"copy constraint violated: col{ca}[{ra}]={va} != col{cb}[{rb}]={vb}"
+        if colv[ca][ra] != colv[cb][rb]:
+            # values are stored reduced by the builder; re-reduce before
+            # declaring violation in case a hand-built assignment was not
+            if int(colv[ca][ra]) % R != int(colv[cb][rb]) % R:
+                raise AssertionError(
+                    f"copy constraint violated: col{ca}[{ra}]={colv[ca][ra]} "
+                    f"!= col{cb}[{rb}]={colv[cb][rb]}")
 
     for j, col in enumerate(assignment.lookup_advice):
         table_set = set(int(v) % R for v in table_values[j][:u])
-        for i in range(u):
-            v = int(col[i]) % R
-            assert v in table_set, f"lookup col {j} row {i}: {v} not in table"
+        bad = [i for i, v in enumerate(col[:u])
+               if v not in table_set and int(v) % R not in table_set]
+        assert not bad, \
+            f"lookup col {j} row {bad[0]}: {col[bad[0]]} not in table"
 
     # --- full polynomial constraint evaluation (same exprs as the prover) ---
     beta, gamma = 0xBEEF, 0xCAFE  # any nonzero values work for satisfaction
