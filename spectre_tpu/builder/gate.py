@@ -112,10 +112,8 @@ class GateChip:
         """sum a_i * b_i as a mul_add chain (bulk-appended: [c, a, b, out]
         units where c chains the previous out; first unit is a bare mul)."""
         assert len(a_vals) == len(b_vals) and a_vals
-        adv = ctx.adv_values
         copies = ctx.copies
-        start = len(adv)
-        pos = start
+        pos = len(ctx.adv_values)
         flat = []
         acc = 0
         first = True
@@ -147,10 +145,8 @@ class GateChip:
     def inner_product_const(self, ctx: Context, vals, consts) -> AssignedValue:
         """sum vals_i * c_i with host constants c_i (bulk-appended chain)."""
         assert len(vals) == len(consts) and vals
-        adv = ctx.adv_values
         copies = ctx.copies
-        start = len(adv)
-        pos = start
+        pos = len(ctx.adv_values)
         flat = []
         acc = 0
         first = True

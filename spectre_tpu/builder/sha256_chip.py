@@ -108,7 +108,7 @@ class Sha256Chip:
         return self.word_from_cell(ctx, cell)
 
     def _recompose(self, ctx: Context, nibs: list) -> Word:
-        cell = self.gate.inner_product_const(ctx, nibs, [1 << (4 * i) for i in range(8)])
+        cell = self.gate.inner_product_const(ctx, nibs, _POW16)
         return Word(cell, nibs)
 
     # -- bitwise ops ----------------------------------------------------

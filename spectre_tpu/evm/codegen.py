@@ -121,9 +121,17 @@ def _pt_words(pt):
 
 
 def gen_evm_verifier(vk: VerifyingKey, srs: SRS, num_instances: int,
-                     contract_name: str = "SpectreVerifier") -> str:
+                     contract_name: str = "SpectreVerifier",
+                     num_acc_limbs: int = 0) -> str:
     """Solidity source for `function verify(uint256[] calldata instances,
-    bytes calldata proof) external view returns (bool)`."""
+    bytes calldata proof) external view returns (bool)`.
+
+    num_acc_limbs=12 (aggregation circuits): the first 12 instances are the
+    deferred KZG accumulator (lhs.x, lhs.y, rhs.x, rhs.y as 3 x 88-bit LE
+    limbs, snark-verifier `LimbsEncoding<3, 88>` parity) and the contract
+    ALSO performs the deferred pairing e(lhs, [tau]_2) == e(rhs, [1]_2) —
+    without it a compressed proof wrapping an invalid inner proof would
+    verify (mirrors `AggregationCircuit.verify`)."""
     cfg = vk.config
     dom = vk.domain
     n, u = cfg.n, cfg.usable_rows
@@ -342,7 +350,38 @@ def gen_evm_verifier(vk: VerifyingKey, srs: SRS, num_instances: int,
              hex(int(g2t[0].c[1])), hex(int(g2t[0].c[0])),
              hex(int(g2t[1].c[1])), hex(int(g2t[1].c[0]))]):
         L(f"pin[{6 + i}] = {val};")
-    L("return _pairing(pin);")
+    if not num_acc_limbs:
+        L("return _pairing(pin);")
+    else:
+        # --- deferred KZG accumulator pairing (aggregation statements) ---
+        assert num_acc_limbs == 12, "accumulator layout is 12 x 88-bit limbs"
+        L('require(_pairing(pin), "outer pairing");')
+        L("// deferred accumulator: e(accL, [tau]_2) * e(-accR, [1]_2) == 1")
+        for c, name in enumerate(["aLx", "aLy", "aRx", "aRy"]):
+            terms = " + ".join(
+                f"(instances[{3 * c + i}] << {88 * i})" if i
+                else f"instances[{3 * c}]"
+                for i in range(3))
+            # limb ranges so the shifted sum cannot wrap uint256 (top limb
+            # < 2^80 since 80 + 176 = 256); the coord < Q check then pins
+            # the canonical value
+            L(f"require(instances[{3 * c}] < (1 << 88) && "
+              f"instances[{3 * c + 1}] < (1 << 88) && "
+              f"instances[{3 * c + 2}] < (1 << 80), \"acc limb range\");")
+            L(f"uint256 {name} = {terms};")
+            L(f"require({name} < Q_MOD, \"acc coord range\");")
+        L("uint256[2] memory negAccR = _negPt([aRx, aRy]);")
+        for i, val in enumerate(
+                ["aLx", "aLy",
+                 hex(int(g2t[0].c[1])), hex(int(g2t[0].c[0])),
+                 hex(int(g2t[1].c[1])), hex(int(g2t[1].c[0]))]):
+            L(f"pin[{i}] = {val};")
+        for i, val in enumerate(
+                ["negAccR[0]", "negAccR[1]",
+                 hex(int(g2g[0].c[1])), hex(int(g2g[0].c[0])),
+                 hex(int(g2g[1].c[1])), hex(int(g2g[1].c[0]))]):
+            L(f"pin[{6 + i}] = {val};")
+        L("return _pairing(pin);")
 
     # temp slots live in one memory array (stack-depth safety); declared first
     body_lines = ([f"uint256[{max(em.num_tmps, 1)}] memory t;"] + em.lines)

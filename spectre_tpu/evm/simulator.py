@@ -48,7 +48,7 @@ def _translate(body_lines: list[str]) -> str:
     indent = 1
     for raw in body_lines:
         s = raw.strip()
-        if not s:
+        if not s or s.startswith("//"):
             continue
         if s == "{":
             continue
@@ -98,7 +98,8 @@ def _stmt(s: str) -> str:
     # require
     m = re.match(r'require\((.*), "(.*)"\)$', s)
     if m:
-        return f"assert {m.group(1)}, {m.group(2)!r}"
+        cond = m.group(1).replace("&&", "and")
+        return f"assert {cond}, {m.group(2)!r}"
     assert "uint256[" not in s, f"untranslated: {s}"
     return s
 

@@ -155,9 +155,12 @@ class AggregationCircuit(AppCircuit):
         return Accumulator.from_limbs(instances[:NUM_ACC_LIMBS]).check(srs)
 
     @classmethod
-    def batch_verify(cls, vk, srs: SRS, items: list) -> bool:
-        """items: [(instances, proof)] — native batch verification of app
-        proofs (the pre-compression fast path used by the RPC layer)."""
-        return all(plonk_verify(vk, srs, [inst], proof,
-                                transcript_cls=PoseidonTranscript)
+    def batch_verify(cls, vk, srs: SRS, items: list,
+                     transcript_cls=None) -> bool:
+        """items: [(instances, proof)] — native verification of a batch of
+        app proofs. Utility API (nothing in the service layer calls it);
+        transcript_cls must match how the proofs were produced (default:
+        the prover's default Blake2b)."""
+        kw = {"transcript_cls": transcript_cls} if transcript_cls else {}
+        return all(plonk_verify(vk, srs, [inst], proof, **kw)
                    for inst, proof in items)

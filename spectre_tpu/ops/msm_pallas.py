@@ -63,79 +63,91 @@ def inf_soa(n: int):
 
 
 # ---------------------------------------------------------------------------
-# in-kernel field arithmetic over lists of [T] limb rows
+# in-kernel field arithmetic over [16, T] limb-row arrays
+#
+# Written in lax.scan/fori_loop form, NOT unrolled: a fully unrolled CIOS is
+# ~2k HLO ops per multiply and took XLA-CPU ~90s to compile ONE multiply;
+# the scan form keeps every function to tens of ops (same lesson as
+# ops/field_ops.py, transposed so lanes run across points).
 # ---------------------------------------------------------------------------
 
+def _p_col():
+    return jnp.asarray(np.array(_P_LIMBS, np.uint32))[:, None]
+
+
 def _k_mont_mul(a, b):
-    """CIOS Montgomery product of two 16-row limb lists (uint32 [T] rows)."""
-    zero = jnp.zeros_like(a[0])
-    t = [zero] * (NL + 1)
-    for j in range(NL):
-        bj = b[j]
-        for i in range(NL):
-            pr = a[i] * bj
-            t[i] = t[i] + (pr & MASK16)
-            t[i + 1] = t[i + 1] + (pr >> 16)
+    """CIOS Montgomery product: a, b [16, T] uint32 -> [16, T]."""
+    lane_shape = a.shape[1:]
+    z1 = jnp.zeros((1,) + lane_shape, jnp.uint32)
+    p_col = _p_col()
+
+    def rnd(t, bj):
+        prod = a * bj[None]
+        t = (t + jnp.concatenate([prod & MASK16, z1], 0)
+             + jnp.concatenate([z1, prod >> 16], 0))
         m = (t[0] * _N0) & MASK16
-        for i in range(NL):
-            q = m * np.uint32(_P_LIMBS[i])
-            t[i] = t[i] + (q & MASK16)
-            t[i + 1] = t[i + 1] + (q >> 16)
+        q = p_col * m[None]
+        t = (t + jnp.concatenate([q & MASK16, z1], 0)
+             + jnp.concatenate([z1, q >> 16], 0))
         carry = t[0] >> 16
-        t = t[1:] + [zero]
-        t[0] = t[0] + carry
+        t = jnp.concatenate([(t[1] + carry)[None], t[2:], z1], 0)
+        return t, None
+
+    t0 = jnp.zeros((NL + 1,) + lane_shape, jnp.uint32)
+    t, _ = jax.lax.scan(rnd, t0, b)
     return _k_carry_sub(t[:NL])
+
+
+def _carry_prop(t):
+    """Carry-propagate a [16, T] accumulator (entries < 2^32)."""
+    def step(c, ti):
+        cur = ti + c
+        return cur >> 16, cur & MASK16
+
+    c, outs = jax.lax.scan(step, jnp.zeros_like(t[0]), t)
+    return outs, c
 
 
 def _k_carry_sub(t):
     """Full carry propagation then conditional subtract of p."""
-    out = []
-    carry = jnp.zeros_like(t[0])
-    for i in range(NL):
-        cur = t[i] + carry
-        out.append(cur & MASK16)
-        carry = cur >> 16
+    out, _top = _carry_prop(t)
     return _k_cond_sub_p(out)
 
 
 def _k_cond_sub_p(a):
-    """a if a < p else a - p (a < 2p, limbs normalized)."""
-    diff = []
-    borrow = jnp.zeros_like(a[0])
-    for i in range(NL):
-        cur = a[i] - np.uint32(_P_LIMBS[i]) - borrow
-        diff.append(cur & MASK16)
-        borrow = (cur >> 16) & np.uint32(1)
-    keep = borrow != 0
-    return [jnp.where(keep, x, d) for x, d in zip(a, diff)]
+    """a if a < p else a - p (a < 2p, limbs normalized). a: [16, T]."""
+    def step(borrow, api):
+        ai, pi = api
+        cur = ai - pi - borrow
+        return (cur >> 16) & np.uint32(1), cur & MASK16
+
+    p_col = jnp.broadcast_to(_p_col(), a.shape)
+    borrow, diff = jax.lax.scan(step, jnp.zeros_like(a[0]), (a, p_col))
+    return jnp.where(borrow != 0, a, diff)
 
 
 def _k_add(a, b):
-    out = []
-    carry = jnp.zeros_like(a[0])
-    for i in range(NL):
-        cur = a[i] + b[i] + carry
-        out.append(cur & MASK16)
-        carry = cur >> 16
+    out, _ = _carry_prop(a + b)
     return _k_cond_sub_p(out)
 
 
 def _k_sub(a, b):
     """a - b mod p via a + (p - b); both inputs reduced (p - 0 = p is
     normalized by the add's conditional subtract)."""
-    pb = []
-    borrow = jnp.zeros_like(a[0])
-    for i in range(NL):
-        cur = np.uint32(_P_LIMBS[i]) - b[i] - borrow
-        pb.append(cur & MASK16)
-        borrow = (cur >> 16) & np.uint32(1)
+    def step(borrow, pbi):
+        pi, bi = pbi
+        cur = pi - bi - borrow
+        return (cur >> 16) & np.uint32(1), cur & MASK16
+
+    p_col = jnp.broadcast_to(_p_col(), b.shape)
+    _, pb = jax.lax.scan(step, jnp.zeros_like(b[0]), (p_col, b))
     return _k_add(a, pb)
 
 
-def _k_padd(p_rows, q_rows):
-    """Complete RCB (alg. 7, a=0, b3=9) add on two 48-row lists."""
-    x1, y1, z1 = p_rows[:NL], p_rows[NL:2 * NL], p_rows[2 * NL:]
-    x2, y2, z2 = q_rows[:NL], q_rows[NL:2 * NL], q_rows[2 * NL:]
+def _k_padd(p_arr, q_arr):
+    """Complete RCB (alg. 7, a=0, b3=9) add on [48, T] arrays."""
+    x1, y1, z1 = p_arr[:NL], p_arr[NL:2 * NL], p_arr[2 * NL:]
+    x2, y2, z2 = q_arr[:NL], q_arr[NL:2 * NL], q_arr[2 * NL:]
 
     t0 = _k_mont_mul(x1, x2)
     t1 = _k_mont_mul(y1, y2)
@@ -165,15 +177,12 @@ def _k_padd(p_rows, q_rows):
     z3a = _k_mont_mul(t0_3, t3)
     z3b = _k_mont_mul(z3p, t4)
 
-    return (_k_sub(x3b, x3a) + _k_add(y3b, y3a) + _k_add(z3b, z3a))
+    return jnp.concatenate(
+        [_k_sub(x3b, x3a), _k_add(y3b, y3a), _k_add(z3b, z3a)], axis=0)
 
 
 def _padd_kernel(p_ref, q_ref, o_ref):
-    p_rows = [p_ref[i, :] for i in range(ROWS)]
-    q_rows = [q_ref[i, :] for i in range(ROWS)]
-    out = _k_padd(p_rows, q_rows)
-    for i in range(ROWS):
-        o_ref[i, :] = out[i]
+    o_ref[:, :] = _k_padd(p_ref[:, :], q_ref[:, :])
 
 
 @functools.partial(jax.jit, static_argnames=("block",))

@@ -28,6 +28,54 @@ R = bn254.R
 ZK_ROWS = 5
 PERM_CHUNK = 2  # columns per permutation grand-product (degree 4 budget)
 
+# ---------------------------------------------------------------------------
+# Wide SHA-256 region (reference: the zkevm "vanilla" SHA circuit wrapped by
+# `gadget/crypto/sha256_wide.rs` — fewer rows, more columns, no lookups).
+# Redesigned for THIS framework's expression machinery: per block slot of
+# SLOT_ROWS rows, 105 bit columns (excluded from the permutation) carry the
+# w/a/e bit ladders + addition carries + an activity flag, and 9 word columns
+# (in the permutation) expose h_in/h_out/input words for copy-linking into
+# the main region. All identities are homogeneous in the advice (the round
+# constant enters as fixed_K * act), so all-zero unused slots satisfy them.
+# ---------------------------------------------------------------------------
+SHA_BIT_COLS = 105      # w[32] | a[32] | e[32] | carries[8] | act
+SHA_WORD_COLS = 9       # h state words [8] | input word column
+SHA_SLOT_ROWS = 72      # 4 seed + 64 rounds + 1 output (+3 spare)
+SHA_SEED_ROW = 3
+SHA_OUT_ROW = 68
+SHA_NUM_SELECTORS = 7   # bit, seed, round, sched, inp, out, act-chain
+SHA_W, SHA_A, SHA_E, SHA_CARRY, SHA_ACT = 0, 32, 64, 96, 104
+
+
+def sha_selector_columns(cfg: "CircuitConfig") -> tuple[list, list]:
+    """Structural fixed content for the SHA region: the 7 selector columns
+    and the round-constant column, patterned per slot (keygen + mock share
+    this single definition)."""
+    from ..ops.sha256 import K as SHA_K
+
+    n, u = cfg.n, cfg.usable_rows
+    nsl = cfg.num_sha_slots
+    sel = [[0] * n for _ in range(SHA_NUM_SELECTORS)]
+    kcol = [0] * n
+    for s in range(nsl):
+        base = s * SHA_SLOT_ROWS
+        assert base + SHA_OUT_ROW < u, "sha slot exceeds usable rows"
+        for r in range(SHA_OUT_ROW + 1):              # q_bit rows 0..68
+            sel[0][base + r] = 1
+        sel[1][base + SHA_SEED_ROW] = 1               # q_seed
+        for t in range(64):                           # q_round rows 4..67
+            sel[2][base + 4 + t] = 1
+        for t in range(16, 64):                       # q_sched rows 20..67
+            sel[3][base + 4 + t] = 1
+        for t in range(16):                           # q_inp rows 4..19
+            sel[4][base + 4 + t] = 1
+        sel[5][base + SHA_OUT_ROW] = 1                # q_out
+        for r in range(1, SHA_OUT_ROW + 1):           # q_act rows 1..68
+            sel[6][base + r] = 1
+        for t in range(64):
+            kcol[base + 4 + t] = int(SHA_K[t])
+    return sel, kcol
+
 
 @dataclass(frozen=True)
 class CircuitConfig:
@@ -46,6 +94,7 @@ class CircuitConfig:
     lookup_bits: int
     num_instance: int = 1
     lookup_tables: tuple = ()
+    num_sha_slots: int = 0
 
     @property
     def n(self) -> int:
@@ -60,8 +109,19 @@ class CircuitConfig:
         return self.usable_rows  # l_last index
 
     @property
+    def num_sha_word(self) -> int:
+        return SHA_WORD_COLS if self.num_sha_slots else 0
+
+    @property
+    def num_sha_bit(self) -> int:
+        return SHA_BIT_COLS if self.num_sha_slots else 0
+
+    @property
     def num_perm_columns(self) -> int:
-        return self.num_advice + self.num_lookup_advice + self.num_fixed + self.num_instance
+        # sha WORD columns join the permutation (copy-linked to the main
+        # region); sha bit columns do not (no copies ever target them)
+        return (self.num_advice + self.num_lookup_advice + self.num_fixed
+                + self.num_sha_word + self.num_instance)
 
     @property
     def num_perm_chunks(self) -> int:
@@ -76,8 +136,12 @@ class CircuitConfig:
     def col_fixed(self, j):
         return self.num_advice + self.num_lookup_advice + j
 
-    def col_instance(self, j):
+    def col_sha_word(self, j):
         return self.num_advice + self.num_lookup_advice + self.num_fixed + j
+
+    def col_instance(self, j):
+        return (self.num_advice + self.num_lookup_advice + self.num_fixed
+                + self.num_sha_word + j)
 
     def table_id(self, j: int) -> str:
         if self.lookup_tables:

@@ -36,8 +36,18 @@ def verify_deferred(vk: VerifyingKey, srs: SRS, instances: list, proof: bytes,
     """Everything but the pairing: transcript replay, identity at x, SHPLONK
     combination. Returns the deferred check (tau_side, one_side) with
     e(tau_side, [tau]_2) == e(one_side, [1]_2), or None if the polynomial
-    identity fails. The aggregation layer's native accumulator oracle and
+    identity fails OR the proof bytes are malformed (short, non-canonical,
+    trailing garbage) — untrusted bytes must yield a boolean reject, not an
+    exception. The aggregation layer's native accumulator oracle and
     `verify` share this single definition."""
+    try:
+        return _verify_deferred_inner(vk, srs, instances, proof, transcript_cls)
+    except (AssertionError, ValueError):
+        return None
+
+
+def _verify_deferred_inner(vk: VerifyingKey, srs: SRS, instances: list,
+                           proof: bytes, transcript_cls):
     cfg = vk.config
     dom = vk.domain
     n, u = cfg.n, cfg.usable_rows

@@ -176,7 +176,8 @@ def _compressed_circuit_cmd(args, spec, circuit, pk, srs, default_args,
         # needed to size it)
         n_inst = 12 + len(circuit.get_instances(default_args, spec))
         src = gen_evm_verifier(agg_pk.vk, srs_agg, num_instances=n_inst,
-                               contract_name=f"Verifier_{agg_cls.name}")
+                               contract_name=f"Verifier_{agg_cls.name}",
+                               num_acc_limbs=12)
         out = args.sol_out or os.path.join(
             BUILD_DIR, f"{agg_cls.name}_{spec.name}_{args.k_agg}_verifier.sol")
         os.makedirs(os.path.dirname(out) or ".", exist_ok=True)

@@ -91,6 +91,62 @@ class TestCodegen:
         assert not run_verifier(src, [29], proof)
 
 
+class TestAccumulatorPairing:
+    """num_acc_limbs=12: the generated contract must ALSO perform the
+    deferred KZG pairing over the first 12 instances — an outer-valid proof
+    wrapping a pairing-INVALID accumulator must be rejected (review finding:
+    without this, compressed proofs over forged inner proofs verified)."""
+
+    @staticmethod
+    def _acc_proof(srs, s: int, valid: bool):
+        from spectre_tpu.builder import Context
+        from spectre_tpu.fields import bn254
+        from spectre_tpu.models.aggregation import Accumulator
+
+        from spectre_tpu.native import host
+
+        g1 = bn254.g1_curve
+        lhs = g1.mul(bn254.G1_GEN, s)          # [s] G1
+        if valid:
+            tau_g = host.limbs_to_ints(srs.g1_powers[1:2].reshape(2, 4))
+            rhs = g1.mul((bn254.Fq(tau_g[0]), bn254.Fq(tau_g[1])), s)
+        else:
+            rhs = g1.mul(bn254.G1_GEN, s + 1)  # wrong: pairing fails
+        acc = Accumulator(lhs=lhs, rhs=rhs)
+        if valid:
+            assert acc.check(srs)
+        else:
+            assert not acc.check(srs)
+
+        ctx = Context()
+        for v in acc.limbs():
+            ctx.expose_public(ctx.load_witness(v))
+        cfg = ctx.auto_config(k=K, lookup_bits=4)
+        advice, lookup, fixed, selectors, copies, instances, _bp = \
+            ctx.layout(cfg)
+        pk = keygen(srs, cfg, fixed, selectors, copies)
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, instances,
+                         copies)
+        proof = prove(pk, srs, asg, transcript=KeccakTranscript())
+        assert verify(pk.vk, srs, instances, proof,
+                      transcript_cls=KeccakTranscript)
+        src = gen_evm_verifier(pk.vk, srs, num_instances=12,
+                               num_acc_limbs=12)
+        return src, instances[0], proof
+
+    def test_valid_accumulator_accepted(self, setup):
+        srs = setup[0]
+        src, inst, proof = self._acc_proof(srs, 12345, valid=True)
+        assert run_verifier(src, inst, proof)
+
+    def test_invalid_accumulator_rejected_despite_valid_outer(self, setup):
+        srs = setup[0]
+        src, inst, proof = self._acc_proof(srs, 12345, valid=False)
+        # the outer PLONK proof itself is valid — only the deferred
+        # accumulator pairing must reject it
+        assert not run_verifier(src, inst, proof)
+
+
 class TestCalldata:
     def test_layout_golden(self, setup):
         _, _, out, proof, _ = setup

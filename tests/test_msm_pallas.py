@@ -1,0 +1,121 @@
+"""Pallas MSM kernel math: the in-kernel field/EC functions are pure jnp on
+limb-row lists, so they are testable WITHOUT pallas_call (Mosaic needs real
+TPU; interpret mode is minutes-slow per call). Everything goes through jit —
+eager execution of the ~30k-op unrolled kernels costs minutes per call.
+
+Oracle: ops/ec (already property-tested against the host curve). The full
+SoA MSM parity run is RUN_SLOW (several compile shapes); device execution of
+the actual pallas_call happens via bench.py on TPU."""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from spectre_tpu.fields import bn254 as bn
+from spectre_tpu.ops import ec, field_ops as F
+from spectre_tpu.ops import msm_pallas as MP
+
+
+def _pts(n, seed=3):
+    g = bn.g1_curve
+    return [g.mul(bn.G1_GEN, seed * k + 1) for k in range(n)]
+
+
+_jit_padd = jax.jit(MP._k_padd)
+_jit_mont_mul = jax.jit(MP._k_mont_mul)
+_jit_add = jax.jit(MP._k_add)
+_jit_sub = jax.jit(MP._k_sub)
+
+
+@pytest.fixture(scope="module")
+def batch():
+    n = 8
+    aos = ec.encode_points(_pts(2 * n))
+    return aos[:n], aos[n:]
+
+
+class TestLayout:
+    def test_soa_roundtrip(self, batch):
+        a, _ = batch
+        back = MP.from_soa(MP.to_soa(a))
+        assert np.array_equal(np.asarray(back), np.asarray(a))
+
+    def test_inf_soa_matches_ec(self):
+        want = np.asarray(ec.inf_point((4,)))
+        got = np.asarray(MP.from_soa(MP.inf_soa(4)))
+        assert np.array_equal(got, want)
+
+
+class TestKernelMath:
+    """_k_* functions on jnp rows vs the tested AoS ops."""
+
+    def test_mont_mul(self, batch):
+        a, b = batch
+        ctx = F.fq_ctx()
+        got = _jit_mont_mul(MP.to_soa(a)[:MP.NL], MP.to_soa(b)[:MP.NL])
+        want = np.asarray(jnp.transpose(
+            F.mont_mul(ctx, a[:, 0], b[:, 0]), (1, 0)))
+        assert np.array_equal(np.asarray(got), want)
+
+    def test_add_sub(self, batch):
+        a, b = batch
+        ctx = F.fq_ctx()
+        x, y = MP.to_soa(a)[:MP.NL], MP.to_soa(b)[:MP.NL]
+        want_add = np.asarray(jnp.transpose(F.add(ctx, a[:, 0], b[:, 0]), (1, 0)))
+        want_sub = np.asarray(jnp.transpose(F.sub(ctx, a[:, 0], b[:, 0]), (1, 0)))
+        assert np.array_equal(np.asarray(_jit_add(x, y)), want_add)
+        assert np.array_equal(np.asarray(_jit_sub(x, y)), want_sub)
+
+    def test_sub_zero_normalizes(self, batch):
+        """p - 0 must normalize to 0-lane behavior (cond-sub path): a - 0 == a."""
+        a, _ = batch
+        x = MP.to_soa(a)[:MP.NL]
+        zero = jnp.zeros_like(x)
+        got = _jit_sub(x, zero)
+        assert np.array_equal(np.asarray(got), np.asarray(x))
+
+    def test_padd_vs_ec(self, batch):
+        a, b = batch
+        got = _jit_padd(MP.to_soa(a), MP.to_soa(b))
+        want = np.asarray(MP.to_soa(ec.padd(a, b)))
+        assert np.array_equal(np.asarray(got), want)
+
+    def test_padd_doubling_and_infinity(self, batch):
+        a, _ = batch
+        inf = ec.inf_point((a.shape[0],))
+        got = _jit_padd(MP.to_soa(a), MP.to_soa(a))
+        want = np.asarray(MP.to_soa(ec.padd(a, a)))
+        assert np.array_equal(np.asarray(got), want)
+        got2 = MP.from_soa(_jit_padd(MP.to_soa(a), MP.to_soa(inf)))
+        assert ec.decode_points(got2) == ec.decode_points(a)
+
+
+@pytest.mark.skipif(not os.environ.get("RUN_SLOW"),
+                    reason="many compile shapes (set RUN_SLOW=1)")
+class TestSegmentedMsm:
+    def test_msm_soa_matches_host(self):
+        """Full SoA MSM (padd_soa monkeypatched to the jit'd kernel math —
+        same code the pallas kernel runs, minus Mosaic) vs the host MSM."""
+        n = 24
+        pts = _pts(n, seed=5)
+        scalars = [(7919 * k + 13) % bn.R for k in range(n)]
+        from spectre_tpu.ops import limbs as L
+        soa = MP.to_soa(ec.encode_points(pts))
+        sc = jnp.asarray(L.ints_to_limbs16(scalars))
+
+        def jnp_padd(p, q, block=None):
+            return _jit_padd(p, q)
+
+        orig = MP.padd_soa
+        MP.padd_soa = jnp_padd
+        try:
+            wins = MP.msm_windows_soa.__wrapped__(soa, sc, 4)
+            res = MP.combine_windows_soa(wins, 4)
+        finally:
+            MP.padd_soa = orig
+        got = ec.decode_points(jnp.asarray(res)[None])[0]
+        want = bn.g1_curve.msm(pts, scalars)
+        assert (int(got[0]), int(got[1])) == (int(want[0]), int(want[1]))

@@ -154,6 +154,21 @@ class TestProveVerify:
         assert verify(pk.vk, srs, [[out]], proof)
         assert not verify(pk.vk, srs, [[out + 1]], proof)
 
+    def test_malformed_proof_bytes_reject_not_raise(self, srs):
+        """Untrusted proof bytes must yield a boolean reject, never an
+        exception: truncated, trailing-garbage, and non-canonical-scalar
+        proofs all return False."""
+        cfg = CircuitConfig(k=K, num_advice=1, num_lookup_advice=1, num_fixed=1,
+                            lookup_bits=4)
+        advice, lookup, fixed, selectors, copies, out = _tiny_circuit(cfg)
+        pk = keygen(srs, cfg, fixed, selectors, copies)
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
+        proof = prove(pk, srs, asg)
+        assert not verify(pk.vk, srs, [[out]], proof + b"\x00" * 7)
+        assert not verify(pk.vk, srs, [[out]], proof[:-5])
+        assert not verify(pk.vk, srs, [[out]], b"")
+        assert not verify(pk.vk, srs, [[out]], proof[:64] + b"\xff" * (len(proof) - 64))
+
     def test_multi_advice_columns(self, srs):
         # two gate columns + wider permutation (multiple chunks exercised)
         cfg = CircuitConfig(k=K, num_advice=2, num_lookup_advice=1, num_fixed=1,
