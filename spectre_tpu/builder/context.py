@@ -46,6 +46,22 @@ class Context:
         self.constants: dict[int, int] = {}   # value -> fixed row
         self.const_uses: list[tuple[int, int]] = []  # (adv idx, fixed row)
         self.instance_cells: list[AssignedValue] = []
+        # wide SHA region slots (builder/sha256_wide_chip.py): per slot,
+        # bits [SLOT_ROWS, SHA_BIT_COLS] uint32 + words [SLOT_ROWS, SHA_WORD_COLS] uint64. Copies
+        # may reference ("shwc", (word_col, global_row)) cells.
+        self.sha_slots: list[dict] = []
+
+    def alloc_sha_slot(self) -> int:
+        """Reserve one wide-SHA block slot; returns its index (global row
+        base = index * SHA_SLOT_ROWS)."""
+        import numpy as np
+        from ..plonk.constraint_system import (SHA_BIT_COLS, SHA_SLOT_ROWS,
+                                               SHA_WORD_COLS)
+        self.sha_slots.append({
+            "bits": np.zeros((SHA_SLOT_ROWS, SHA_BIT_COLS), np.uint32),
+            "words": np.zeros((SHA_SLOT_ROWS, SHA_WORD_COLS), np.uint64),
+        })
+        return len(self.sha_slots) - 1
 
     # -- stream access --
     def stream_values(self, stream) -> list[int]:
@@ -213,9 +229,15 @@ class Context:
         if not tables:
             tables = ["range"]  # config always carries at least one table
         num_fixed = max(1, (len(self.constants) + u - 1) // u)
+        nsl = len(self.sha_slots)
+        if nsl:
+            from ..plonk.constraint_system import SHA_SLOT_ROWS
+            assert nsl * SHA_SLOT_ROWS <= u, \
+                "sha slots exceed usable rows: raise k"
         return CircuitConfig(k=k, num_advice=num_advice,
                              num_lookup_advice=len(tables), num_fixed=num_fixed,
-                             lookup_bits=lookup_bits, lookup_tables=tuple(tables))
+                             lookup_bits=lookup_bits, lookup_tables=tuple(tables),
+                             num_sha_slots=nsl)
 
     def layout(self, cfg: CircuitConfig):
         """Place units into columns. Returns (advice_cols, lookup_cols,
@@ -299,6 +321,9 @@ class Context:
             if stream == "adv":
                 c, r = placement[idx]
                 return (cfg.col_gate_advice(c), r)
+            if stream == "shwc":
+                j, grow = idx
+                return (cfg.col_sha_word(j), grow)
             c, r = lkp_placement[(stream[1], idx)]
             return (cfg.col_lookup_advice(c), r)
 
@@ -313,6 +338,26 @@ class Context:
                            (cfg.col_instance(0), i)))
         return advice, lookup, fixed, selectors, copies, instances, break_points
 
+    def sha_columns(self, cfg: CircuitConfig):
+        """Materialize the slot list into full [cols, n] region columns."""
+        import numpy as np
+        from ..plonk.constraint_system import (SHA_BIT_COLS, SHA_SLOT_ROWS,
+                                               SHA_WORD_COLS)
+        if not self.sha_slots:
+            return None, None
+        assert cfg.num_sha_slots >= len(self.sha_slots), \
+            "config allocates fewer sha slots than the circuit used"
+        n = cfg.n
+        sha_bit = np.zeros((SHA_BIT_COLS, n), np.uint32)
+        sha_word = np.zeros((SHA_WORD_COLS, n), np.uint64)
+        for s, slot in enumerate(self.sha_slots):
+            base = s * SHA_SLOT_ROWS
+            sha_bit[:, base:base + SHA_SLOT_ROWS] = slot["bits"].T
+            sha_word[:, base:base + SHA_SLOT_ROWS] = slot["words"].T
+        return sha_bit, sha_word
+
     def assignment(self, cfg: CircuitConfig) -> Assignment:
         advice, lookup, fixed, selectors, copies, instances, _bp = self.layout(cfg)
-        return Assignment(cfg, advice, lookup, fixed, selectors, instances, copies)
+        sha_bit, sha_word = self.sha_columns(cfg)
+        return Assignment(cfg, advice, lookup, fixed, selectors, instances,
+                          copies, sha_bit=sha_bit, sha_word=sha_word)

@@ -156,11 +156,12 @@ class AggregationCircuit(AppCircuit):
 
     @classmethod
     def batch_verify(cls, vk, srs: SRS, items: list,
-                     transcript_cls=None) -> bool:
+                     transcript_cls=PoseidonTranscript) -> bool:
         """items: [(instances, proof)] — native verification of a batch of
-        app proofs. Utility API (nothing in the service layer calls it);
-        transcript_cls must match how the proofs were produced (default:
-        the prover's default Blake2b)."""
-        kw = {"transcript_cls": transcript_cls} if transcript_cls else {}
-        return all(plonk_verify(vk, srs, [inst], proof, **kw)
+        app proofs. Utility API (nothing in the service layer calls it).
+        Default transcript is Poseidon because app snarks bound for
+        aggregation are produced that way (prover_service cli two-stage
+        flow); pass Blake2b/Keccak for standalone proofs."""
+        return all(plonk_verify(vk, srs, [inst], proof,
+                                transcript_cls=transcript_cls)
                    for inst, proof in items)

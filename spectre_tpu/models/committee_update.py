@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from ..builder import Context, GateChip
 from ..builder.poseidon_chip import PoseidonChip
-from ..builder.sha256_chip import Sha256Chip
+from ..builder.sha256_wide_chip import Sha256WideChip
 from ..fields import bn254
 from ..gadgets import poseidon_commit as PC
 from ..gadgets import ssz_merkle as M
@@ -28,8 +28,11 @@ class CommitteeUpdateCircuit(AppCircuit):
 
     @classmethod
     def build(cls, ctx: Context, args: CommitteeUpdateArgs, spec):
+        """Hashing runs on the wide-region chip (reference uses the zkevm
+        wide SHA here for the same reason: this circuit is hash-dominated,
+        `committee_update_circuit.rs:50` + `sha256_wide.rs`)."""
         gate = GateChip()
-        sha = Sha256Chip(gate)
+        sha = Sha256WideChip(gate)
         poseidon = PoseidonChip(gate)
         n = spec.sync_committee_size
         assert len(args.pubkeys_compressed) == n

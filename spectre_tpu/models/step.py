@@ -34,6 +34,7 @@ from ..builder.hash_to_curve_chip import HashToCurveChip
 from ..builder.pairing_chip import PairingChip
 from ..builder.poseidon_chip import PoseidonChip
 from ..builder.sha256_chip import Sha256Chip
+from ..builder.sha256_wide_chip import Sha256WideChip
 from ..fields import bls12_381 as bls
 from ..gadgets import poseidon_commit as PC
 from ..gadgets import ssz_merkle as M
@@ -65,14 +66,18 @@ class StepCircuit(AppCircuit):
               native_precheck: bool = True):
         gate = GateChip()
         rng = RangeChip(cls.default_lookup_bits, gate)
-        sha = Sha256Chip(gate)
+        # SSZ/merkle/pub-input hashing runs in the wide region; the
+        # hash-to-curve expand_message keeps the nibble chip (its XOR
+        # plumbing works on nibble-decomposed words)
+        sha = Sha256WideChip(gate)
+        sha_nib = Sha256Chip(gate)
         poseidon = PoseidonChip(gate)
         fp = FpChip(rng)
         fp2 = Fp2Chip(fp)
         ecc = EccChip(fp)
         g2 = G2Chip(fp2)
         pairing = PairingChip(Fp12Chip(fp2))
-        h2c = HashToCurveChip(pairing, sha)
+        h2c = HashToCurveChip(pairing, sha_nib)
         n = spec.sync_committee_size
         assert len(args.pubkeys_uncompressed) == n
         assert len(args.participation_bits) == n

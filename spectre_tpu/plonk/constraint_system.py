@@ -32,19 +32,23 @@ PERM_CHUNK = 2  # columns per permutation grand-product (degree 4 budget)
 # Wide SHA-256 region (reference: the zkevm "vanilla" SHA circuit wrapped by
 # `gadget/crypto/sha256_wide.rs` — fewer rows, more columns, no lookups).
 # Redesigned for THIS framework's expression machinery: per block slot of
-# SLOT_ROWS rows, 105 bit columns (excluded from the permutation) carry the
-# w/a/e bit ladders + addition carries + an activity flag, and 9 word columns
-# (in the permutation) expose h_in/h_out/input words for copy-linking into
-# the main region. All identities are homogeneous in the advice (the round
+# SLOT_ROWS rows, SHA_BIT_COLS bit columns (excluded from the permutation)
+# carry the w/a/e bit ladders + addition carries, and SHA_WORD_COLS word
+# columns (in the permutation) expose h_in/h_out/input words + the pinned
+# activity flag for copy-linking into the main region. All identities are homogeneous in the advice (the round
 # constant enters as fixed_K * act), so all-zero unused slots satisfy them.
 # ---------------------------------------------------------------------------
-SHA_BIT_COLS = 105      # w[32] | a[32] | e[32] | carries[8] | act
-SHA_WORD_COLS = 9       # h state words [8] | input word column
+SHA_BIT_COLS = 104      # w[32] | a[32] | e[32] | carries[8]
+SHA_WORD_COLS = 10      # h state words [8] | input words | act flag
 SHA_SLOT_ROWS = 72      # 4 seed + 64 rounds + 1 output (+3 spare)
 SHA_SEED_ROW = 3
 SHA_OUT_ROW = 68
 SHA_NUM_SELECTORS = 7   # bit, seed, round, sched, inp, out, act-chain
-SHA_W, SHA_A, SHA_E, SHA_CARRY, SHA_ACT = 0, 32, 64, 96, 104
+SHA_W, SHA_A, SHA_E, SHA_CARRY = 0, 32, 64, 96
+# act lives in a WORD column (permutation-enabled) so the chip can PIN it to
+# the constant 1 on used slots — were it a plain bit column, a malicious
+# prover could zero it and prove a K-less hash variant
+SHA_ACT_WORD = 9
 
 
 def sha_selector_columns(cfg: "CircuitConfig") -> tuple[list, list]:
@@ -173,6 +177,11 @@ class Assignment:
     selectors: list         # [num_advice][n] 0/1 ints
     instances: list         # [num_instance][<=usable] ints
     copies: list = field(default_factory=list)
+    # wide SHA region witness (numpy, small dtypes — these columns are
+    # megacell-scale): [num_sha_bit][n] uint32 bits, [num_sha_word][n]
+    # uint64 32-bit words
+    sha_bit: object = None
+    sha_word: object = None
 
     def instance_column(self, j) -> list:
         col = [0] * self.config.n

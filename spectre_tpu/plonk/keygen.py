@@ -32,6 +32,8 @@ class VerifyingKey:
     fixed_commits: list
     sigma_commits: list
     table_commits: list    # one per lookup-advice column (cfg.table_id(j))
+    sha_selector_commits: list = None   # 7 region selectors (num_sha_slots)
+    sha_k_commit: object = None         # round-constant column
 
     @property
     def domain(self) -> Domain:
@@ -42,10 +44,13 @@ class VerifyingKey:
         h = hashlib.blake2b(digest_size=32)
         cfg = self.config
         h.update(repr((cfg.k, cfg.num_advice, cfg.num_lookup_advice, cfg.num_fixed,
-                       cfg.lookup_bits, cfg.num_instance)).encode())
+                       cfg.lookup_bits, cfg.num_instance,
+                       cfg.num_sha_slots)).encode())
         h.update(repr(cfg.lookup_tables).encode())
         for pt in (self.selector_commits + self.fixed_commits
-                   + self.sigma_commits + self.table_commits):
+                   + self.sigma_commits + self.table_commits
+                   + (self.sha_selector_commits or [])
+                   + ([self.sha_k_commit] if cfg.num_sha_slots else [])):
             h.update(bn254.g1_to_bytes(pt))
         return h.digest()
 
@@ -60,6 +65,10 @@ class VerifyingKey:
             keys.append(("adv", j))
         for j in range(cfg.num_lookup_advice):
             keys.append(("ladv", j))
+        for j in range(cfg.num_sha_bit):
+            keys.append(("shb", j))
+        for j in range(cfg.num_sha_word):
+            keys.append(("shw", j))
         for j in range(cfg.num_lookup_advice):
             keys.append(("pA", j))
             keys.append(("pT", j))
@@ -84,6 +93,10 @@ class VerifyingKey:
             out[("fix", j)] = c
         for j, c in enumerate(self.sigma_commits):
             out[("sig", j)] = c
+        for j, c in enumerate(self.sha_selector_commits or []):
+            out[("shq", j)] = c
+        if self.sha_k_commit is not None or self.config.num_sha_slots:
+            out[("shk", 0)] = self.sha_k_commit
         return out
 
     def query_plan(self):
@@ -113,6 +126,32 @@ class VerifyingKey:
             plan.append((("sig", j), 0))
         for j in range(cfg.num_lookup_advice):
             plan.append((("tab", j), 0))
+        if cfg.num_sha_slots:
+            from .constraint_system import (SHA_A, SHA_ACT_WORD, SHA_CARRY,
+                                            SHA_E, SHA_OUT_ROW, SHA_SEED_ROW,
+                                            SHA_W)
+            for i in range(32):                       # w bits
+                for rot in (0, -2, -7, -15, -16):
+                    plan.append((("shb", SHA_W + i), rot))
+            for i in range(32):                       # a bits
+                for rot in (0, -1, -2, -3, -4):
+                    plan.append((("shb", SHA_A + i), rot))
+            for i in range(32):                       # e bits
+                for rot in (0, -1, -2, -3, -4):
+                    plan.append((("shb", SHA_E + i), rot))
+            for i in range(8):                        # carries
+                plan.append((("shb", SHA_CARRY + i), 0))
+            back = SHA_SEED_ROW - SHA_OUT_ROW
+            for j in range(8):
+                plan.append((("shw", j), 0))
+                plan.append((("shw", j), back))
+            plan.append((("shw", 8), 0))
+            plan.append((("shw", SHA_ACT_WORD), 0))   # act flag
+            plan.append((("shw", SHA_ACT_WORD), -1))
+            from .constraint_system import SHA_NUM_SELECTORS
+            for s in range(SHA_NUM_SELECTORS):
+                plan.append((("shq", s), 0))
+            plan.append((("shk", 0), 0))
         for i in range(3):
             plan.append((("h", i), 0))
         return plan
@@ -138,6 +177,8 @@ class ProvingKey:
     fixed_values: list
     sigma_values: list        # int lists
     table_values: list        # one list per lookup-advice column
+    sha_selector_polys: list = None
+    sha_k_poly: object = None
 
 
 def keygen(srs: SRS, cfg: CircuitConfig, fixed_columns: list, selectors: list,
@@ -171,6 +212,16 @@ def keygen(srs: SRS, cfg: CircuitConfig, fixed_columns: list, selectors: list,
     tab_commit_by_id = {tid: kzg.commit(srs, p, bk)
                         for tid, p in tab_poly_by_id.items()}
 
+    sha_sel_polys, sha_k_poly = None, None
+    sha_sel_commits, sha_k_commit = None, None
+    if cfg.num_sha_slots:
+        from .constraint_system import sha_selector_columns
+        sha_sel, sha_k = sha_selector_columns(cfg)
+        sha_sel_polys = [to_poly(v) for v in sha_sel]
+        sha_k_poly = to_poly(sha_k)
+        sha_sel_commits = [kzg.commit(srs, p, bk) for p in sha_sel_polys]
+        sha_k_commit = kzg.commit(srs, sha_k_poly, bk)
+
     vk = VerifyingKey(
         config=cfg,
         selector_commits=[kzg.commit(srs, p, bk) for p in sel_polys],
@@ -178,6 +229,10 @@ def keygen(srs: SRS, cfg: CircuitConfig, fixed_columns: list, selectors: list,
         sigma_commits=[kzg.commit(srs, p, bk) for p in sig_polys],
         table_commits=[tab_commit_by_id[cfg.table_id(j)]
                        for j in range(cfg.num_lookup_advice)],
+        sha_selector_commits=sha_sel_commits,
+        sha_k_commit=sha_k_commit,
     )
     return ProvingKey(vk, sel_polys, fix_polys, sig_polys, tab_polys,
-                      sel_vals, fix_vals, sigma_vals, tab_vals)
+                      sel_vals, fix_vals, sigma_vals, tab_vals,
+                      sha_selector_polys=sha_sel_polys,
+                      sha_k_poly=sha_k_poly)

@@ -115,6 +115,8 @@ def mock_prove(cfg: CircuitConfig, assignment: Assignment, fixed_values=None,
             colv.append(assignment.lookup_advice[j])
         elif kind == "fix":
             colv.append(fixed_values[j])
+        elif kind == "shw":
+            colv.append(assignment.sha_word[j])
         else:
             colv.append(assignment.instance_column(j))
 
@@ -151,6 +153,16 @@ def mock_prove(cfg: CircuitConfig, assignment: Assignment, fixed_values=None,
         columns[("tab", j)] = B.to_arr([int(x) % R for x in table_values[j]])
     for j in range(cfg.num_instance):
         columns[("inst", j)] = B.to_arr(assignment.instance_column(j))
+    if cfg.num_sha_slots:
+        from .constraint_system import sha_selector_columns
+        for j in range(cfg.num_sha_bit):
+            columns[("shb", j)] = B.to_arr(assignment.sha_bit[j].tolist())
+        for j in range(cfg.num_sha_word):
+            columns[("shw", j)] = B.to_arr(assignment.sha_word[j].tolist())
+        sha_sel, sha_k = sha_selector_columns(cfg)
+        for j, v in enumerate(sha_sel):
+            columns[("shq", j)] = B.to_arr(v)
+        columns[("shk", 0)] = B.to_arr(sha_k)
 
     # grand products, mirroring the prover (vectorized: the per-chunk
     # num/den columns are backend products with ONE batch inversion)

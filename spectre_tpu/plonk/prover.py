@@ -110,6 +110,10 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
 
     adv_vals = [blind(v) for v in assignment.advice]
     ladv_vals = [blind(v) for v in assignment.lookup_advice]
+    shb_vals = [blind(assignment.sha_bit[j].tolist())
+                for j in range(cfg.num_sha_bit)]
+    shw_vals = [blind(assignment.sha_word[j].tolist())
+                for j in range(cfg.num_sha_word)]
     inst_vals = [assignment.instance_column(j) for j in range(cfg.num_instance)]
 
     polys: dict = {}      # key -> coefficient form
@@ -127,6 +131,10 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
             commit_col(("adv", j), v)
         for j, v in enumerate(ladv_vals):
             commit_col(("ladv", j), v)
+        for j, v in enumerate(shb_vals):
+            commit_col(("shb", j), v)
+        for j, v in enumerate(shw_vals):
+            commit_col(("shw", j), v)
 
     # --- 2. lookup permuted columns ---
     with phase("prove/lookup_permute"):
@@ -150,6 +158,8 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
             return ladv_vals[j]
         if kind == "fix":
             return pk.fixed_values[j]
+        if kind == "shw":
+            return shw_vals[j]
         if kind == "inst":
             return inst_vals[j]
         raise KeyError(key)
@@ -214,6 +224,11 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
                 ext_cache[key] = dom.coeff_to_extended(pk.sigma_polys[key[1]], bk)
             elif key[0] == "tab":
                 ext_cache[key] = dom.coeff_to_extended(pk.table_polys[key[1]], bk)
+            elif key[0] == "shq":
+                ext_cache[key] = dom.coeff_to_extended(
+                    pk.sha_selector_polys[key[1]], bk)
+            elif key[0] == "shk":
+                ext_cache[key] = dom.coeff_to_extended(pk.sha_k_poly, bk)
             elif key[0] == "inst":
                 coeffs = dom.lagrange_to_coeff(B.to_arr(inst_vals[key[1]]), bk)
                 polys[key] = coeffs
@@ -281,6 +296,10 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
             return pk.sigma_polys[j]
         if kind == "tab":
             return pk.table_polys[j]
+        if kind == "shq":
+            return pk.sha_selector_polys[j]
+        if kind == "shk":
+            return pk.sha_k_poly
         raise KeyError(key)
 
     with phase("prove/evals"):
