@@ -124,6 +124,49 @@ class Fp12Chip:
                 s[k] = t if s[k] is None else lz.add(ctx, s[k], t)
         return self._fold_and_reduce(ctx, s)
 
+    def cyclotomic_square(self, ctx: Context, a) -> tuple:
+        """Granger–Scott squaring, valid ONLY for elements of the cyclotomic
+        subgroup (as everything after the final exponentiation's easy part
+        is): with g0=(z0,z3), g1=(z1,z4), g2=(z2,z5) in Fp4 = Fp2[V],
+        V = w^3, V^2 = xi, and A=g0^2, C=g1^2, B=g2^2:
+            h0 = 3A - 2*conj(g0)   h1 = 3*V*B + 2*conj(g1)
+            h2 = 3C - 2*conj(g2)
+        Cost: 3 Fp4 squarings (27 limb convolutions) vs the generic
+        symmetric square's 21 Fq2 products (63 convolutions) — the final
+        exp's ~315 chain squarings are the dominant convolution count in
+        the pairing. Formula numerically validated against the host tower
+        (a non-cyclotomic input does NOT satisfy it; inputs here are
+        constraint-forced into the subgroup by the easy part)."""
+        lz = self.lazy
+        big = lz.big
+
+        def scale2(p, k):
+            return (big.scale_ovf(ctx, p[0], k), big.scale_ovf(ctx, p[1], k))
+
+        def two(p):
+            return scale2(lz.lift(ctx, p), 2)
+
+        def sq4(za, zb):
+            # (za + zb V)^2 = (za^2 + xi zb^2) + (2 za zb) V
+            ta = lz.mul(ctx, za, za)
+            tb = lz.mul(ctx, zb, zb)
+            zs = lz.add(ctx, lz.lift(ctx, za), lz.lift(ctx, zb))
+            ts = lz.mul(ctx, zs, zs)
+            tab = lz.sub(ctx, lz.sub(ctx, ts, ta), tb)
+            return lz.add(ctx, ta, lz.mul_by_xi(ctx, tb)), tab
+
+        z = a
+        A0, A1 = sq4(z[0], z[3])
+        B0, B1 = sq4(z[2], z[5])
+        C0, C1 = sq4(z[1], z[4])
+        y0 = lz.sub(ctx, scale2(A0, 3), two(z[0]))
+        y3 = lz.add(ctx, scale2(A1, 3), two(z[3]))
+        y1 = lz.add(ctx, scale2(lz.mul_by_xi(ctx, B1), 3), two(z[1]))
+        y4 = lz.sub(ctx, scale2(B0, 3), two(z[4]))
+        y2 = lz.sub(ctx, scale2(C0, 3), two(z[2]))
+        y5 = lz.add(ctx, scale2(C1, 3), two(z[5]))
+        return tuple(lz.reduce(ctx, y) for y in (y0, y1, y2, y3, y4, y5))
+
     def conjugate(self, ctx: Context, a) -> tuple:
         """f^(p^6): w -> -w (gamma6 = -1): negate odd slots."""
         fp2 = self.fp2
@@ -186,14 +229,16 @@ class Fp12Chip:
         return inv
 
     # -- exponentiation by |x| (BLS parameter), for the final exp -------
-    def pow_abs_x(self, ctx: Context, a) -> tuple:
+    def pow_abs_x(self, ctx: Context, a, cyclotomic: bool = False) -> tuple:
         """a^|x|, |x| = 0xd201000000010000 (square-and-multiply over the
-        fixed bit pattern; bits 63,62,60,57,48,16)."""
+        fixed bit pattern; bits 63,62,60,57,48,16). cyclotomic=True uses
+        Granger–Scott squaring — only valid for subgroup elements."""
         absx = -bls.BLS_X
         bits = bin(absx)[2:]
+        sq = self.cyclotomic_square if cyclotomic else self.square
         acc = a
         for bit in bits[1:]:
-            acc = self.square(ctx, acc)
+            acc = sq(ctx, acc)
             if bit == "1":
                 acc = self.mul(ctx, acc, a)
         return acc

@@ -129,19 +129,23 @@ class PairingChip:
         t = fp12.mul(ctx, fp12.frobenius(ctx, t, 2), t)
 
         # hard (3x multiple): 3 + (x-1)^2 (x+p) (x^2+p^2-1); t is now
-        # cyclotomic so inverse == conjugate and x<0 folds into conjugates
+        # cyclotomic so inverse == conjugate, x<0 folds into conjugates,
+        # and every chain square uses Granger-Scott cyclotomic squaring
         def pow_x_minus_1(u):
             # u^(x-1) = conj(u^|x| * u)
-            return fp12.conjugate(ctx, fp12.mul(ctx, fp12.pow_abs_x(ctx, u), u))
+            return fp12.conjugate(ctx, fp12.mul(
+                ctx, fp12.pow_abs_x(ctx, u, cyclotomic=True), u))
 
         a = pow_x_minus_1(t)
         a = pow_x_minus_1(a)
-        b = fp12.mul(ctx, fp12.conjugate(ctx, fp12.pow_abs_x(ctx, a)),
+        b = fp12.mul(ctx, fp12.conjugate(
+                         ctx, fp12.pow_abs_x(ctx, a, cyclotomic=True)),
                      fp12.frobenius(ctx, a, 1))
-        bx2 = fp12.pow_abs_x(ctx, fp12.pow_abs_x(ctx, b))
+        bx2 = fp12.pow_abs_x(ctx, fp12.pow_abs_x(ctx, b, cyclotomic=True),
+                             cyclotomic=True)
         c2 = fp12.mul(ctx, fp12.mul(ctx, bx2, fp12.frobenius(ctx, b, 2)),
                       fp12.conjugate(ctx, b))
-        t3 = fp12.mul(ctx, fp12.square(ctx, t), t)
+        t3 = fp12.mul(ctx, fp12.cyclotomic_square(ctx, t), t)
         return fp12.mul(ctx, c2, t3)
 
     def assert_pairing_product_one(self, ctx: Context, pairs):

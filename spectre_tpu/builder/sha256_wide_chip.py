@@ -170,11 +170,19 @@ class Sha256WideChip(Sha256Chip):
             state = self._fill_slot(ctx, slot, state,
                                     [w.value for w in blk])
             prev_slot = slot
-        # mirror the final digest into the main region
+        # mirror the final digest into the main region. The out-row identity
+        # pins h_out only mod 2^32 with a boolean carry — without a 32-bit
+        # range check here a prover could shift a digest word (and the
+        # carry bit) by 2^32 and expose sha256(msg) + 2^32 (found by
+        # review, PoC'd against mock_prove). Range-checking the mirror
+        # makes the candidate unique, which pins the carry bit too.
+        # (Intermediate blocks need no check: the next slot's seed identity
+        # recombines h_in from boolean ladder bits, forcing < 2^32.)
         out = []
         obase = prev_slot * SHA_SLOT_ROWS + SHA_OUT_ROW
         for j in range(8):
             cell = ctx.load_witness(state[j])
+            self._range_bits(ctx, cell, 32)
             copies.append((("adv", cell.index), ("shwc", (j, obase))))
             out.append(WideWord(cell))
         return out

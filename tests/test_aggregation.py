@@ -125,6 +125,38 @@ class TestInCircuitVerifier:
             rhs=(bn254.Fq(rhs[0].value % P), bn254.Fq(rhs[1].value % P)),
         ).check(srs)
 
+    def test_sha_region_inner_proof_aggregates(self):
+        """An inner proof whose circuit uses the wide-SHA region (extra
+        commitment/query-plan keys: shb/shw/shq/shk) must flow through the
+        in-circuit verifier and close the deferred pairing."""
+        from spectre_tpu.builder import GateChip
+        from spectre_tpu.builder.sha256_wide_chip import Sha256WideChip
+        from spectre_tpu.gadgets import ssz_merkle as M
+
+        ctx = Context()
+        sha = Sha256WideChip(GateChip())
+        cells = M.load_bytes_checked(ctx, sha, b"agg over wide sha")
+        digest = sha.digest_bytes(ctx, cells)
+        ctx.expose_public(digest[0].cell)
+        cfg = ctx.auto_config(k=9, lookup_bits=5)
+        asg = ctx.assignment(cfg)
+        srs = SRS.unsafe_setup(11)
+        pk = keygen(srs, cfg, asg.fixed, asg.selectors, asg.copies)
+        proof = prove(pk, srs, asg, transcript=PoseidonTranscript())
+
+        acc = VerifierChip.native_accumulator(pk.vk, srs, asg.instances,
+                                              proof)
+        assert acc is not None and acc.check(srs)
+        vctx = Context()
+        vc = VerifierChip(RangeChip(lookup_bits=14))
+        icells = [[vctx.load_witness(int(v)) for v in col]
+                  for col in asg.instances]
+        lhs, rhs = vc.verify_proof(vctx, pk.vk, srs, icells, proof)
+        assert (lhs[0].value % P, lhs[1].value % P) == \
+            (int(acc.lhs[0]), int(acc.lhs[1]))
+        assert (rhs[0].value % P, rhs[1].value % P) == \
+            (int(acc.rhs[0]), int(acc.rhs[1]))
+
     def test_invalid_proof_rejected_at_witness_time(self, inner):
         pk, srs, instances, proof = inner
         ctx = Context()

@@ -52,6 +52,16 @@ class TestFp12Chip:
         assert fp12.value(fp12.square(ctx, a)) == x * x
         _mock(ctx, k=14)
 
+    def test_cyclotomic_square_vs_host(self):
+        """Granger–Scott squaring == true square for a cyclotomic element
+        (f^((p^6-1)(p^2+1))), with a satisfied mock — the final exp's chain
+        squares all run through this path."""
+        ctx, fp, fp2, fp12 = _chips()
+        t = _rand_fq12() ** ((bls.P ** 6 - 1) * (bls.P ** 2 + 1))
+        a = fp12.load(ctx, t)
+        assert fp12.value(fp12.cyclotomic_square(ctx, a)) == t * t
+        _mock(ctx, k=14)
+
     def test_frobenius_conjugate_inverse_vs_host(self):
         ctx, fp, fp2, fp12 = _chips()
         x = _rand_fq12()

@@ -86,6 +86,42 @@ class TestWideSoundness:
         with pytest.raises(AssertionError):
             mock_prove(cfg, asg)
 
+    def test_carry_shift_digest_forgery_rejected(self):
+        """The ±2^32 digest forgery (review PoC): flip the out-row carry bit
+        AND consistently shift the h_out word, its mirrored advice cell and
+        the instance by 2^32. Must be rejected by the 32-bit range check on
+        the mirror (pre-fix, mock_prove ACCEPTED this)."""
+        ctx, _, digest, _ = _build_digest(b"carry forge")
+        for w in digest:
+            ctx.expose_public(w.cell)
+        cfg = ctx.auto_config(k=9, lookup_bits=5)
+        asg = ctx.assignment(cfg)
+        nsl = len(ctx.sha_slots)
+        orow = (nsl - 1) * SHA_SLOT_ROWS + SHA_OUT_ROW
+        from spectre_tpu.plonk.constraint_system import SHA_CARRY
+        # find a word whose true out-carry is 1 (so flipping to 0 shifts +2^32)
+        target = None
+        for j in range(8):
+            if int(asg.sha_bit[SHA_CARRY + j, orow]) == 1:
+                target = j
+                break
+        if target is None:
+            pytest.skip("no carry-1 word in this digest (vanishing odds)")
+        asg.sha_bit[SHA_CARRY + target, orow] = 0
+        forged = int(asg.sha_word[target, orow]) + (1 << 32)
+        asg.sha_word[target, orow] = forged
+        # shift the mirrored advice cell + every stream copy of it
+        mirror_idx = digest[target].cell.index
+        old = digest[target].cell.value
+        for c in range(cfg.num_advice):
+            col = asg.advice[c]
+            for r in range(len(col)):
+                if col[r] == old:
+                    col[r] = forged
+        asg.instances[0][target] = forged
+        with pytest.raises(AssertionError):
+            mock_prove(cfg, asg)
+
     def test_zeroed_act_rejected(self):
         """Zeroing act (the K-less hash attack) must violate either the act
         pin copy or the round identity."""
