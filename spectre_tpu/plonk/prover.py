@@ -119,22 +119,34 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
     polys: dict = {}      # key -> coefficient form
     values: dict = {}     # key -> int list (lagrange values)
 
-    def commit_col(key, vals):
+    def commit_col(key, vals, arr=None):
         values[key] = vals
-        coeffs = dom.lagrange_to_coeff(B.to_arr(vals), bk)
+        if arr is None:
+            arr = B.to_arr(vals)
+        coeffs = dom.lagrange_to_coeff(arr, bk)
         polys[key] = coeffs
         pt = kzg.commit(srs, coeffs, bk)
         tr.write_point(pt)
 
     with phase("prove/commit_advice"):
-        for j, v in enumerate(adv_vals):
-            commit_col(("adv", j), v)
-        for j, v in enumerate(ladv_vals):
-            commit_col(("ladv", j), v)
-        for j, v in enumerate(shb_vals):
-            commit_col(("shb", j), v)
-        for j, v in enumerate(shw_vals):
-            commit_col(("shw", j), v)
+        # pipelined commits (SURVEY §2c axis (c)): host-side limb
+        # marshalling of column i+1 overlaps the backend NTT+MSM of column
+        # i on a worker thread (ctypes/JAX release the GIL during backend
+        # calls). Transcript order is unchanged — results are consumed
+        # strictly in sequence.
+        from concurrent.futures import ThreadPoolExecutor
+
+        items = ([(("adv", j), v) for j, v in enumerate(adv_vals)]
+                 + [(("ladv", j), v) for j, v in enumerate(ladv_vals)]
+                 + [(("shb", j), v) for j, v in enumerate(shb_vals)]
+                 + [(("shw", j), v) for j, v in enumerate(shw_vals)])
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            fut = ex.submit(B.to_arr, items[0][1]) if items else None
+            for i, (key, vals) in enumerate(items):
+                arr = fut.result()
+                if i + 1 < len(items):
+                    fut = ex.submit(B.to_arr, items[i + 1][1])
+                commit_col(key, vals, arr=arr)
 
     # --- 2. lookup permuted columns ---
     with phase("prove/lookup_permute"):
