@@ -1,0 +1,138 @@
+"""Live-HTTP round trip for the Beacon REST client (reference parity: the
+reference's live-network preprocessor tests, `preprocessor/src/step.rs:160`,
+run against a real Lodestar endpoint; zero-egress here, so a local
+http.server serves Beacon-API-shaped JSON built from the deterministic
+fixtures and the REAL BeaconClient + converters consume it)."""
+
+import dataclasses
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from spectre_tpu import spec as SP
+from spectre_tpu.fields import bls12_381 as bls
+from spectre_tpu.preprocessor import (BeaconClient,
+                                      rotation_args_from_update,
+                                      step_args_from_finality_update)
+from spectre_tpu.witness import (default_committee_update_args,
+                                 default_sync_step_args)
+from spectre_tpu.witness.types import bytes48_root
+from spectre_tpu.gadgets.ssz_merkle import verify_merkle_proof_native
+from spectre_tpu.witness.rotation import mock_root
+
+TINY = dataclasses.replace(SP.MINIMAL, name="tiny", sync_committee_size=2)
+
+
+def _hdr_json(h):
+    return {"slot": str(h.slot), "proposer_index": str(h.proposer_index),
+            "parent_root": "0x" + h.parent_root.hex(),
+            "state_root": "0x" + h.state_root.hex(),
+            "body_root": "0x" + h.body_root.hex()}
+
+
+@pytest.fixture(scope="module")
+def server():
+    sargs = default_sync_step_args(TINY)
+    cargs = default_committee_update_args(TINY)
+
+    bits = bytearray((len(sargs.participation_bits) + 7) // 8)
+    for i, b in enumerate(sargs.participation_bits):
+        if b:
+            bits[i // 8] |= 1 << (i % 8)
+
+    finality_update = {
+        "attested_header": _hdr_json(sargs.attested_header),
+        "finalized_header": _hdr_json(sargs.finalized_header),
+        "finality_branch": ["0x" + b.hex() for b in sargs.finality_branch],
+        "execution_payload_root": "0x" + sargs.execution_payload_root.hex(),
+        "execution_branch": ["0x" + b.hex()
+                             for b in sargs.execution_payload_branch],
+        "sync_aggregate": {
+            "sync_committee_bits": "0x" + bytes(bits).hex(),
+            "sync_committee_signature":
+                "0x" + sargs.signature_compressed.hex(),
+        },
+    }
+    # the chain serves the container-depth branch; the converter performs
+    # the aggregate-pubkey extension ("magic swap")
+    agg = bls.g1_compress(bls.sk_to_pk(424242))
+    cont_branch = [b"\x11" * 32] * TINY.sync_committee_depth
+    state_root = mock_root(
+        cargs.committee_pubkeys_root(),
+        [bytes48_root(agg)] + cont_branch,
+        TINY.sync_committee_pubkeys_root_index)
+    hdr = dataclasses.replace(cargs.finalized_header, state_root=state_root)
+    committee_update = {
+        "finalized_header": _hdr_json(hdr),
+        "next_sync_committee": {
+            "pubkeys": ["0x" + pk.hex() for pk in cargs.pubkeys_compressed],
+            "aggregate_pubkey": "0x" + agg.hex(),
+        },
+        "next_sync_committee_branch": ["0x" + b.hex() for b in cont_branch],
+    }
+
+    routes = {
+        "/eth/v1/beacon/light_client/finality_update":
+            {"data": finality_update},
+        "/eth/v1/beacon/light_client/updates?start_period=7&count=1":
+            [{"data": committee_update}],
+        "/eth/v1/beacon/blocks/head/root":
+            {"data": {"root": "0x" + (b"\xab" * 32).hex()}},
+    }
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = routes.get(self.path)
+            if body is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            data = json.dumps(body).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):   # quiet
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_port}", sargs, cargs
+    httpd.shutdown()
+
+
+class TestBeaconHttpRoundTrip:
+    def test_finality_update_to_step_args(self, server):
+        url, sargs, _ = server
+        client = BeaconClient(url)
+        update = client.finality_update()
+        got = step_args_from_finality_update(
+            update, [bls.g1_compress((bls.Fq(x), bls.Fq(y)))
+                     for x, y in sargs.pubkeys_uncompressed],
+            sargs.domain, TINY)
+        assert got.signing_root() == sargs.signing_root()
+        assert got.participation_bits == sargs.participation_bits
+        assert got.pubkeys_uncompressed == sargs.pubkeys_uncompressed
+
+    def test_committee_update_to_rotation_args(self, server):
+        url, _, cargs = server
+        client = BeaconClient(url)
+        update = client.committee_updates(period=7)[0]
+        got = rotation_args_from_update(update, TINY)
+        assert got.pubkeys_compressed == cargs.pubkeys_compressed
+        # branch was extended by the aggregate-pubkey sibling and verifies
+        assert len(got.sync_committee_branch) == TINY.sync_committee_depth + 1
+        assert verify_merkle_proof_native(
+            got.committee_pubkeys_root(), got.sync_committee_branch,
+            TINY.sync_committee_pubkeys_root_index,
+            got.finalized_header.state_root)
+
+    def test_head_root(self, server):
+        url, _, _ = server
+        assert BeaconClient(url).head_block_root() == \
+            "0x" + (b"\xab" * 32).hex()
