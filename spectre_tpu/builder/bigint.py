@@ -17,7 +17,6 @@ from dataclasses import dataclass
 from ..fields import bn254
 from ..spec import LIMB_BITS, NUM_LIMBS
 from .context import AssignedValue, Context
-from .gate import GateChip
 from .range_chip import RangeChip
 
 R = bn254.R

@@ -12,8 +12,6 @@ witness or one vertical-gate activation q*(s0 + s1*s2 - s3) = 0).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-
 from ..fields import bn254
 from ..plonk.constraint_system import Assignment, CircuitConfig
 

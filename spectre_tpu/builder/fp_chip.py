@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from ..fields import bls12_381 as bls
 from .bigint import BigUintChip, CrtUint, OverflowInt
-from .context import AssignedValue, Context
+from .context import Context
 from .range_chip import RangeChip
 
 P = bls.P

@@ -20,14 +20,12 @@ subclasses it to reuse the byte/nibble range plumbing.
 
 from __future__ import annotations
 
-import numpy as np
-
 from ..ops.sha256 import H0, K
 from ..plonk.constraint_system import (SHA_A, SHA_ACT_WORD, SHA_CARRY, SHA_E,
                                        SHA_OUT_ROW, SHA_SEED_ROW,
                                        SHA_SLOT_ROWS, SHA_W)
 from .context import AssignedValue, Context
-from .sha256_chip import Sha256Chip, Word
+from .sha256_chip import Sha256Chip
 
 M32 = 0xFFFFFFFF
 

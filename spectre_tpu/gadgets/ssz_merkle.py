@@ -10,7 +10,7 @@ from __future__ import annotations
 import hashlib
 
 from ..builder.context import Context
-from ..builder.sha256_chip import Sha256Chip, Word
+from ..builder.sha256_chip import Sha256Chip
 
 
 # -- native mirrors (witness-side; preprocessor uses these too) --------------

@@ -16,7 +16,7 @@ from ..builder.sha256_wide_chip import Sha256WideChip
 from ..fields import bn254
 from ..gadgets import poseidon_commit as PC
 from ..gadgets import ssz_merkle as M
-from ..spec import LIMB_BITS, NUM_LIMBS
+from ..spec import NUM_LIMBS
 from ..witness.types import CommitteeUpdateArgs
 from .app_circuit import AppCircuit
 

@@ -14,9 +14,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..fields import bn254
 from . import field_ops as F
-from . import limbs as L
 
 
 def _fq():

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..fields import bls12_381, bn254
+from ..fields import bn254
 from . import limbs as L
 
 NLIMBS = 16

@@ -20,7 +20,6 @@ import numpy as np
 
 from ..fields import bn254
 from . import field_ops as F
-from . import limbs as L
 
 R = bn254.R
 

@@ -13,7 +13,6 @@ import functools
 import numpy as np
 
 from ..fields import bn254
-from ..native import host
 from . import backend as B
 
 R = bn254.R

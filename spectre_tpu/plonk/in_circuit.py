@@ -25,10 +25,8 @@ from ..builder.transcript_chip import TranscriptChip
 from ..fields import bn254
 from .expressions import all_expressions
 from .keygen import ROT_LAST, VerifyingKey
-from .kzg import OpenEntry
 from .srs import SRS
 from .transcript import PoseidonTranscript
-from . import kzg
 
 R = bn254.R
 P = bn254.P

@@ -10,11 +10,9 @@ from __future__ import annotations
 import hashlib
 from dataclasses import dataclass
 
-import numpy as np
-
 from ..fields import bn254
 from . import backend as B
-from .constraint_system import Assignment, CircuitConfig, build_sigma, table_column
+from .constraint_system import CircuitConfig, build_sigma, table_column
 from .domain import Domain
 from .srs import SRS
 from . import kzg

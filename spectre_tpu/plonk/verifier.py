@@ -11,7 +11,7 @@ from __future__ import annotations
 from ..fields import bn254
 from . import kzg
 from .expressions import ScalarCtx, all_expressions
-from .keygen import ROT_LAST, VerifyingKey
+from .keygen import VerifyingKey
 from .srs import SRS
 from .transcript import Blake2bTranscript
 

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import threading
 
-from .. import spec as spec_mod
 from ..models import CommitteeUpdateCircuit, StepCircuit
 from ..plonk import backend as B
 from ..plonk.srs import SRS

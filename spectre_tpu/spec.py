@@ -8,7 +8,7 @@ spec; circuits take a Spec instance instead of Rust's monomorphized generics.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 # BLS signature domain-separation tag (same for all reference networks,
 # `spec.rs` `DST`). One definition; bls12_381 hashing takes it as an argument.

@@ -8,7 +8,6 @@ without any chain data (used for keygen and tests).
 
 from __future__ import annotations
 
-from .. import spec as spec_mod
 from ..fields import bls12_381 as bls
 from .rotation import mock_root
 from .types import BeaconBlockHeader, SyncStepArgs
