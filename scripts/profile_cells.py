@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Per-component advice/lookup cell budget of the StepCircuit building blocks.
+
+Run: python scripts/profile_cells.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from spectre_tpu.builder import Context, GateChip, RangeChip
+from spectre_tpu.builder.fp_chip import EccChip, FpChip
+from spectre_tpu.builder.fp2_chip import Fp2Chip, G2Chip
+from spectre_tpu.builder.fp12_chip import Fp12Chip
+from spectre_tpu.builder.hash_to_curve_chip import HashToCurveChip
+from spectre_tpu.builder.pairing_chip import PairingChip
+from spectre_tpu.builder.sha256_chip import Sha256Chip
+from spectre_tpu.fields import bls12_381 as bls
+
+
+def cost(label, fn):
+    ctx = Context()
+    gate = GateChip()
+    rng = RangeChip(16, gate)
+    fp = FpChip(rng)
+    fp2 = Fp2Chip(fp)
+    ecc = EccChip(fp)
+    g2 = G2Chip(fp2)
+    fp12 = Fp12Chip(fp2)
+    pairing = PairingChip(fp12)
+    sha_nib = Sha256Chip(gate)
+    h2c = HashToCurveChip(pairing, sha_nib)
+    t0 = time.time()
+    fn(ctx, dict(gate=gate, rng=rng, fp=fp, fp2=fp2, ecc=ecc, g2=g2,
+                 fp12=fp12, pairing=pairing, sha=sha_nib, h2c=h2c))
+    dt = time.time() - t0
+    st = ctx.stats()
+    lkp = sum(st["lookup_cells"].values())
+    print(f"{label:42s} adv={st['advice_cells']:>9,} lkp={lkp:>9,} "
+          f"copies={st['copies']:>8,}  {dt:5.1f}s")
+    return st["advice_cells"]
+
+
+G1 = bls.G1_GEN
+G2pt = bls.G2_GEN
+P2 = bls.g2_curve.mul(G2pt, 7)
+P1 = bls.g1_curve.mul(G1, 5)
+
+
+def main():
+    which = sys.argv[1:] or ["fp", "g1", "g2", "fp12", "miller", "finalexp",
+                             "pairing2", "subgroup", "h2c"]
+    C = {}
+    if "fp" in which:
+        cost("fp.mul x100", lambda c, k: [
+            k["fp"].mul(c, k["fp"].load(c, 12345), k["fp"].load(c, 6789))
+            for _ in range(100)])
+    if "g1" in which:
+        cost("ecc.load_point (on-curve)", lambda c, k: k["ecc"].load_point(c, P1))
+        cost("ecc.add_unequal_lazy", lambda c, k: k["ecc"].add_unequal_lazy(
+            c, k["ecc"].load_point(c, P1), k["ecc"].load_point(c, G1)))
+    if "g2" in which:
+        cost("g2.load_point", lambda c, k: k["g2"].load_point(c, P2))
+        cost("g2.add_unequal", lambda c, k: k["g2"].add_unequal(
+            c, k["g2"].load_point(c, P2), k["g2"].load_point(c, G2pt)))
+        cost("g2.double", lambda c, k: k["g2"].double(c, k["g2"].load_point(c, P2)))
+    if "fp12" in which:
+        def f12(c, k):
+            a = k["fp12"].load(c, bls.Fq12([i + 1 for i in range(12)]))
+            b = k["fp12"].load(c, bls.Fq12([2 * i + 3 for i in range(12)]))
+            k["fp12"].mul(c, a, b)
+        cost("fp12.mul", f12)
+
+        def f12sq(c, k):
+            a = k["fp12"].load(c, bls.Fq12([i + 1 for i in range(12)]))
+            k["fp12"].square(c, a)
+        cost("fp12.square", f12sq)
+    if "miller" in which:
+        def ml(c, k):
+            p = k["ecc"].load_point(c, P1)
+            q = k["g2"].load_point(c, P2)
+            k["pairing"].multi_miller_loop(c, [(p, q)])
+        cost("miller_loop 1 pair", ml)
+    if "finalexp" in which:
+        def fe(c, k):
+            a = k["fp12"].load(c, bls.pairing(P2, P1))
+            k["pairing"].assert_final_exp_one_unsafe(c, a) \
+                if hasattr(k["pairing"], "assert_final_exp_one_unsafe") else None
+        # final exp measured within pairing2 below if no direct API
+    if "pairing2" in which:
+        def p2(c, k):
+            p = k["ecc"].load_point(c, P1)
+            np_ = k["ecc"].load_point(c, bls.g1_curve.neg(P1))
+            q = k["g2"].load_point(c, P2)
+            s = bls.g2_curve.mul(P2, 1)  # e(P,Q)*e(-P,Q) == 1
+            q2 = k["g2"].load_point(c, s)
+            k["pairing"].assert_pairing_product_one(c, [(p, q), (np_, q2)])
+        cost("pairing product (2 pairs + final exp)", p2)
+    if "subgroup" in which:
+        def sg(c, k):
+            q = k["g2"].load_point(c, P2 if False else G2pt)
+            k["pairing"].assert_g2_subgroup(c, q)
+        cost("g2 subgroup check", sg)
+    if "h2c" in which:
+        def h(c, k):
+            msg = [c.load_witness(i & 0xFF) for i in range(32)]
+            for m in msg:
+                k["sha"]._range_bits(c, m, 8)
+            k["h2c"].hash_to_g2(c, msg, b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_")
+        cost("hash_to_g2 (full)", h)
+
+
+if __name__ == "__main__":
+    main()

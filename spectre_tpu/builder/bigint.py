@@ -173,6 +173,12 @@ class BigUintChip:
                            min(la, lb) * xa.limb_abs * (BASE - 1),
                            xa.val_abs * k)
 
+    def const_ovf(self, ctx: Context, k: int) -> OverflowInt:
+        """A small non-negative host constant as a single-limb OverflowInt
+        (centralizes the limb_abs/val_abs bounds)."""
+        assert 0 <= k < self.base
+        return OverflowInt([ctx.load_constant(k)], k, k, k + 1)
+
     def add_ovf(self, ctx: Context, x: OverflowInt, y: OverflowInt) -> OverflowInt:
         gate = self.gate
         nc = min(len(x.limbs), len(y.limbs))

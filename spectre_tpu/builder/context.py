@@ -215,7 +215,8 @@ class Context:
         """Column counts sized from actual stream lengths (reference parity:
         halo2-lib `calculate_params`, `sync_step_circuit.rs:421-427`)."""
         probe = CircuitConfig(k=k, num_advice=1, num_lookup_advice=1,
-                              num_fixed=1, lookup_bits=lookup_bits)
+                              num_fixed=1, lookup_bits=lookup_bits,
+                              num_sha_slots=len(self.sha_slots))
         u = probe.usable_rows
         # advice columns: account for per-unit padding at column breaks (worst
         # case wastes <= 3 rows per column)

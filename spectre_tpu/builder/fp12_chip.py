@@ -60,7 +60,7 @@ def frobenius_constants():
 class Fp12Chip:
     def __init__(self, fp2: Fp2Chip):
         self.fp2 = fp2
-        self.lazy = Fp2Lazy(fp2)
+        self.lazy = fp2.lz   # the one shared lazy engine (fp2_chip.py)
 
     # -- loading --------------------------------------------------------
     def load(self, ctx: Context, coeffs) -> tuple:

@@ -20,6 +20,15 @@ P = bls.P
 class Fp2Chip:
     def __init__(self, fp: FpChip):
         self.fp = fp
+        self._lz = None
+
+    @property
+    def lz(self) -> "Fp2Lazy":
+        # internal lazy engine (created on first use; Fp2Lazy(self) is just
+        # two attribute grabs, the cycle is benign)
+        if self._lz is None:
+            self._lz = Fp2Lazy(self)
+        return self._lz
 
     def load(self, ctx: Context, v) -> tuple:
         """v: fields.bls12_381.Fq2 or (c0, c1) ints."""
@@ -41,20 +50,17 @@ class Fp2Chip:
         return (self.fp.sub(ctx, a[0], b[0]), self.fp.sub(ctx, a[1], b[1]))
 
     def mul(self, ctx: Context, a, b) -> tuple:
-        """(a0 + a1 u)(b0 + b1 u) = (a0b0 - a1b1) + (a0b1 + a1b0) u."""
-        a0b0 = self.fp.mul(ctx, a[0], b[0])
-        a1b1 = self.fp.mul(ctx, a[1], b[1])
-        a0b1 = self.fp.mul(ctx, a[0], b[1])
-        a1b0 = self.fp.mul(ctx, a[1], b[0])
-        return (self.fp.sub(ctx, a0b0, a1b1), self.fp.add(ctx, a0b1, a1b0))
+        """(a0 + a1 u)(b0 + b1 u) = (a0b0 - a1b1) + (a0b1 + a1b0) u.
+        Runs on the lazy engine (Karatsuba: 3 limb convolutions) with one
+        reduction per output coefficient."""
+        lz = self.lz
+        return lz.reduce(ctx, lz.mul(ctx, a, b))
 
     def square(self, ctx: Context, a) -> tuple:
-        """(a0^2 - a1^2) + 2 a0 a1 u (complex squaring)."""
-        s = self.fp.add(ctx, a[0], a[1])
-        d = self.fp.sub(ctx, a[0], a[1])
-        c0 = self.fp.mul(ctx, s, d)
-        a0a1 = self.fp.mul(ctx, a[0], a[1])
-        return (c0, self.fp.mul_scalar(ctx, a0a1, 2))
+        """(a0^2 - a1^2) + 2 a0 a1 u (complex squaring, lazy: 2 limb
+        convolutions + 2 reductions)."""
+        lz = self.lz
+        return lz.reduce(ctx, lz.square(ctx, a))
 
     def mul_scalar(self, ctx: Context, a, k: int) -> tuple:
         return (self.fp.mul_scalar(ctx, a[0], k), self.fp.mul_scalar(ctx, a[1], k))
@@ -68,12 +74,14 @@ class Fp2Chip:
         return (a[0], self.fp.sub(ctx, zero, a[1]))
 
     def div_unsafe(self, ctx: Context, a, b) -> tuple:
-        """q with q*b == a; witness the quotient, constrain the product."""
+        """q with q*b == a; witness the quotient, constrain q*b - a ≡ 0 via
+        the lazy engine (3 convolutions + 2 quotient-only reductions — no
+        eager product or remainder witnesses)."""
+        lz = self.lz
         av, bv = self.value(a), self.value(b)
         qv = av / bv
         q = self.load(ctx, qv)
-        prod = self.mul(ctx, q, b)
-        self.assert_equal(ctx, prod, a)
+        lz.assert_zero(ctx, lz.sub(ctx, lz.mul(ctx, q, b), lz.lift(ctx, a)))
         return q
 
     def assert_equal(self, ctx: Context, a, b):
@@ -87,14 +95,9 @@ class Fp2Chip:
                 self.fp.select(ctx, bit, a[1], b[1]))
 
     def assert_nonzero(self, ctx: Context, a):
-        """Constrain a != 0 in Fp2 via witnessed inverse a*inv == 1 (same
-        soundness argument as FpChip.assert_nonzero)."""
-        av = self.value(a)
-        assert av != bls.Fq2([0, 0]), "assert_nonzero: witness is zero"
-        inv = self.load(ctx, bls.Fq2([1, 0]) / av)
-        prod = self.mul(ctx, a, inv)
-        one = self.load_constant(ctx, (1, 0))
-        self.assert_equal(ctx, prod, one)
+        """Constrain a != 0 in Fp2 via witnessed inverse a*inv - 1 ≡ 0 (same
+        soundness argument as FpChip.assert_nonzero), on the lazy engine."""
+        self.lz.assert_nonzero(ctx, a)
 
 
 class Fp2Lazy:
@@ -135,6 +138,44 @@ class Fp2Lazy:
         t01 = big.mul_ovf(ctx, sa, sb)
         cross = big.sub_ovf(ctx, big.sub_ovf(ctx, t01, t0), t1)
         return (big.sub_ovf(ctx, t0, t1), cross)
+
+    def square(self, ctx: Context, a) -> tuple:
+        """Complex squaring, lazy: ((a0+a1)(a0-a1), 2 a0 a1) — 2 limb
+        convolutions. a: reduced pair or OverflowInt pair."""
+        big = self.big
+        oa0 = big.to_overflow(a[0], self.FQ_BITS)
+        oa1 = big.to_overflow(a[1], self.FQ_BITS)
+        s = big.add_ovf(ctx, oa0, oa1)
+        d = big.sub_ovf(ctx, oa0, oa1)
+        c0 = big.mul_ovf(ctx, s, d)
+        a0a1 = big.mul_ovf(ctx, oa0, oa1)
+        return (c0, big.scale_ovf(ctx, a0a1, 2))
+
+    def scale(self, ctx: Context, x, k: int) -> tuple:
+        """Lazy pair times a small non-negative host constant."""
+        big = self.big
+        return (big.scale_ovf(ctx, x[0], k), big.scale_ovf(ctx, x[1], k))
+
+    def assert_zero(self, ctx: Context, x) -> None:
+        """Constrain a lazy pair ≡ (0, 0) mod p (quotient-only reductions)."""
+        big = self.big
+        big.assert_zero_mod(ctx, x[0], P)
+        big.assert_zero_mod(ctx, x[1], P)
+
+    def value(self, x) -> "bls.Fq2":
+        """Host value of a lazy (or reduced) pair."""
+        return bls.Fq2([x[0].value % P, x[1].value % P])
+
+    def assert_nonzero(self, ctx: Context, x) -> None:
+        """Constrain a lazy pair != 0 via witnessed inverse: x*inv - 1 ≡ 0."""
+        from .bigint import OverflowInt
+        big = self.big
+        v = self.value(x)
+        assert v != bls.Fq2([0, 0]), "assert_nonzero: witness is zero"
+        inv = self.fp2.load(ctx, bls.Fq2([1, 0]) / v)
+        prod = self.mul(ctx, x, inv)
+        one = big.const_ovf(ctx, 1)
+        self.assert_zero(ctx, (big.sub_ovf(ctx, prod[0], one), prod[1]))
 
     def mul_by_fq_cell(self, ctx: Context, a, x: "CrtUint") -> tuple:
         """Fq2 pair times a base-field CrtUint cell."""
@@ -186,41 +227,85 @@ class Fp2Lazy:
 
 class G2Chip:
     """Non-native G2 affine arithmetic over Fp2Chip (reference: halo2-ecc
-    `EccChip<Fp2>` — the signature-side group of `assign_signature:279`)."""
+    `EccChip<Fp2>` — the signature-side group of `assign_signature:279`).
+
+    All point formulas run on the lazy engine: the chord/tangent identities
+    are constrained directly on unreduced accumulations (λ·dx - dy ≡ 0 etc.),
+    so an add costs 2 quotient-only checks + 4 reductions instead of ~10
+    eager Fq2 operations."""
 
     def __init__(self, fp2: Fp2Chip):
         self.fp2 = fp2
 
     def load_point(self, ctx: Context, pt) -> tuple:
-        """On-curve check y^2 == x^3 + 4(1+u)."""
-        x = self.fp2.load(ctx, pt[0])
-        y = self.fp2.load(ctx, pt[1])
-        y2 = self.fp2.square(ctx, y)
-        x3 = self.fp2.mul(ctx, self.fp2.square(ctx, x), x)
-        b2 = self.fp2.load_constant(ctx, bls.B2)
-        rhs = self.fp2.add(ctx, x3, b2)
-        self.fp2.assert_equal(ctx, y2, rhs)
+        """On-curve check y^2 - x^3 - 4(1+u) ≡ 0, lazy (2 squares + 1 mul
+        as convolutions, one intermediate reduction, 2 zero checks)."""
+        from .bigint import OverflowInt
+        fp2 = self.fp2
+        lz = fp2.lz
+        x = fp2.load(ctx, pt[0])
+        y = fp2.load(ctx, pt[1])
+        y2 = lz.square(ctx, y)
+        x2r = lz.reduce(ctx, lz.square(ctx, x))
+        x3 = lz.mul(ctx, x2r, x)
+        t = lz.sub(ctx, y2, x3)
+        b0, b1 = int(bls.B2.c[0]), int(bls.B2.c[1])
+        big = lz.big
+        t = (big.sub_ovf(ctx, t[0], big.const_ovf(ctx, b0)),
+             big.sub_ovf(ctx, t[1], big.const_ovf(ctx, b1)))
+        lz.assert_zero(ctx, t)
         return (x, y)
 
-    def add_unequal(self, ctx: Context, p, q, strict: bool = True) -> tuple:
-        """Chord addition; strict constrains x1 != x2 (see EccChip.add_unequal)."""
-        x1, y1 = p
-        x2, y2 = q
-        dx = self.fp2.sub(ctx, x2, x1)
+    # -- lazy chord/tangent cores (shared with PairingChip's Miller steps) --
+    def add_core(self, ctx: Context, t_pt, q_pt, strict: bool = True) -> tuple:
+        """((T+Q), chord slope λ). strict constrains x_T != x_Q — without it
+        T == ±Q lets any witnessed slope satisfy 0·λ = 0 (see
+        EccChip.add_unequal). Operands are reduced Fq2 pairs."""
+        fp2 = self.fp2
+        lz = fp2.lz
+        xt, yt = t_pt
+        xq, yq = q_pt
+        dx = lz.sub(ctx, lz.lift(ctx, xt), lz.lift(ctx, xq))
+        dy = lz.sub(ctx, lz.lift(ctx, yt), lz.lift(ctx, yq))
         if strict:
-            self.fp2.assert_nonzero(ctx, dx)
-        lam = self.fp2.div_unsafe(ctx, self.fp2.sub(ctx, y2, y1), dx)
-        lam2 = self.fp2.square(ctx, lam)
-        x3 = self.fp2.sub(ctx, self.fp2.sub(ctx, lam2, x1), x2)
-        y3 = self.fp2.sub(ctx, self.fp2.mul(ctx, lam, self.fp2.sub(ctx, x1, x3)), y1)
-        return (x3, y3)
+            lz.assert_nonzero(ctx, dx)
+        lam = fp2.load(ctx, lz.value(dy) / lz.value(dx))
+        # λ·dx - dy ≡ 0
+        lz.assert_zero(ctx, lz.sub(ctx, lz.mul(ctx, lam, dx), dy))
+        lam2 = lz.mul(ctx, lam, lam)
+        oxt = lz.lift(ctx, xt)
+        x3 = lz.reduce(ctx, lz.sub(ctx, lz.sub(ctx, lam2, oxt),
+                                   lz.lift(ctx, xq)))
+        d13 = lz.sub(ctx, oxt, lz.lift(ctx, x3))
+        y3 = lz.reduce(ctx, lz.sub(ctx, lz.mul(ctx, lam, d13),
+                                   lz.lift(ctx, yt)))
+        return (x3, y3), lam
+
+    def double_core(self, ctx: Context, t_pt) -> tuple:
+        """((2T), tangent slope λ): constrain 2·(λ·y) - 3·x² ≡ 0 directly
+        (no reduced intermediates for the slope identity). y != 0 always
+        holds on-curve: no order-2 points with b != 0 twists here."""
+        fp2 = self.fp2
+        lz = fp2.lz
+        x, y = t_pt
+        xv, yv = fp2.value(x), fp2.value(y)
+        lam = fp2.load(ctx, xv * xv * bls.Fq2([3, 0]) / (yv * bls.Fq2([2, 0])))
+        lamy = lz.mul(ctx, lam, y)
+        x2 = lz.square(ctx, x)
+        lz.assert_zero(ctx, lz.sub(ctx, lz.scale(ctx, lamy, 2),
+                                   lz.scale(ctx, x2, 3)))
+        lam2 = lz.mul(ctx, lam, lam)
+        ox = lz.lift(ctx, x)
+        x3 = lz.reduce(ctx, lz.sub(ctx, lz.sub(ctx, lam2, ox), ox))
+        d13 = lz.sub(ctx, ox, lz.lift(ctx, x3))
+        y3 = lz.reduce(ctx, lz.sub(ctx, lz.mul(ctx, lam, d13),
+                                   lz.lift(ctx, y)))
+        return (x3, y3), lam
+
+    def add_unequal(self, ctx: Context, p, q, strict: bool = True) -> tuple:
+        pt, _lam = self.add_core(ctx, p, q, strict=strict)
+        return pt
 
     def double(self, ctx: Context, p) -> tuple:
-        x1, y1 = p
-        three_x2 = self.fp2.mul_scalar(ctx, self.fp2.square(ctx, x1), 3)
-        two_y = self.fp2.mul_scalar(ctx, y1, 2)
-        lam = self.fp2.div_unsafe(ctx, three_x2, two_y)
-        lam2 = self.fp2.square(ctx, lam)
-        x3 = self.fp2.sub(ctx, self.fp2.sub(ctx, lam2, x1), x1)
-        y3 = self.fp2.sub(ctx, self.fp2.mul(ctx, lam, self.fp2.sub(ctx, x1, x3)), y1)
-        return (x3, y3)
+        pt, _lam = self.double_core(ctx, p)
+        return pt

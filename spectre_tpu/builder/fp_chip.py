@@ -158,13 +158,18 @@ class EccChip:
         return self.constrain_on_curve(ctx, xc, yc)
 
     def constrain_on_curve(self, ctx: Context, xc, yc) -> tuple:
-        """On-curve check for already-loaded coordinates."""
-        y2 = self.fp.mul(ctx, yc, yc)
-        x2 = self.fp.mul(ctx, xc, xc)
-        x3 = self.fp.mul(ctx, x2, xc)
-        bc = self.fp.load_constant(ctx, self.b)
-        rhs = self.fp.add(ctx, x3, bc)
-        self.fp.assert_equal(ctx, y2, rhs)
+        """On-curve check y² - x³ - b ≡ 0 for already-loaded coordinates,
+        lazy: 3 limb convolutions, one intermediate reduction (x² — needed to
+        keep the cubic's quotient within limb width), one quotient-only
+        zero check."""
+        fp, big = self.fp, self.fp.big
+        p = fp.p
+        bits = p.bit_length()
+        y2 = big.mul_ovf(ctx, yc, yc, bits)
+        x2r = big.carry_mod_ovf(ctx, big.mul_ovf(ctx, xc, xc, bits), p)
+        x3 = big.mul_ovf(ctx, x2r, xc, bits)
+        t = big.sub_ovf(ctx, y2, x3)
+        big.assert_zero_mod(ctx, big.sub_ovf(ctx, t, big.const_ovf(ctx, self.b)), p)
         return (xc, yc)
 
     def add_unequal(self, ctx: Context, p, q, strict: bool = True) -> tuple:
@@ -222,8 +227,7 @@ class EccChip:
             assert dx.value % p != 0, "add_unequal_lazy: P == ±Q"
             inv = fp.load(ctx, pow(dx.value % p, -1, p))
             t = big.mul_ovf(ctx, dx, inv, bits)
-            one = OverflowInt([ctx.load_constant(1)], 1, 1, 2)
-            big.assert_zero_mod(ctx, big.sub_ovf(ctx, t, one), p)
+            big.assert_zero_mod(ctx, big.sub_ovf(ctx, t, big.const_ovf(ctx, 1)), p)
         lam = fp.load(ctx, self._lam_witness(dy.value, dx.value))
         # λ·dx - dy ≡ 0
         big.assert_zero_mod(

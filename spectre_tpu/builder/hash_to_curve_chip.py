@@ -32,13 +32,20 @@ R = bn254.R
 
 
 class HashToCurveChip:
-    def __init__(self, pairing: PairingChip, sha: Sha256Chip):
+    def __init__(self, pairing: PairingChip, sha: Sha256Chip,
+                 sha_wide=None):
+        """sha: the nibble-lookup chip (XOR plumbing + nibble recomposition).
+        sha_wide: optional Sha256WideChip — when present, expand_message's
+        SHA compressions run in the wide bit-ladder region (~200 main cells
+        per block vs ~45k in the nibble chip), with only the digest XOR mix
+        and the field recomposition on nibbles."""
         self.pairing = pairing
         self.fp2 = pairing.fp2
         self.fp = self.fp2.fp
         self.lz = pairing.lz
         self.g2 = pairing.g2
         self.sha = sha
+        self.sha_wide = sha_wide
 
     # ------------------------------------------------------------------
     # expand_message_xmd
@@ -76,6 +83,72 @@ class HashToCurveChip:
             tail += [("c", b) for b in bytes([i]) + dst_prime]
             prev = self._digest_tail(ctx, sha.initial_state(ctx), tail,
                                      total_len=32 + 1 + len(dst_prime))
+            outs.append(prev)
+        return outs
+
+    def expand_message_xmd_wide(self, ctx: Context, msg_bytes: list,
+                                dst: bytes, len_in_bytes: int) -> list:
+        """expand_message_xmd with the compressions in the wide SHA region.
+        Digest words come back as single cells; they are nibble-decomposed
+        (lookup-checked) once each, for the b0 XOR mix and the downstream
+        field recomposition. Returns nibble-chip Words like the nibble
+        path."""
+        shaw = self.sha_wide
+        sha = self.sha
+        assert len(dst) <= 255
+        ell = (len_in_bytes + 31) // 32
+        assert ell <= 255 and len_in_bytes % 32 == 0
+        dst_prime = dst + bytes([len(dst)])
+        lib = len_in_bytes.to_bytes(2, "big")
+
+        def pack_words(byte_items: list, total_len: int, skipped: int) -> list:
+            """byte_items: cells ('v') or ints ('c'); pads for a message of
+            total_len bytes of which `skipped` were folded into the
+            midstate; packs 4 bytes -> 1 word cell."""
+            stream = list(byte_items)
+            blen = len(stream) + 1
+            stream.append(0x80)
+            while ((skipped + blen) % 64) != 56:
+                stream.append(0)
+                blen += 1
+            stream += list((8 * total_len).to_bytes(8, "big"))
+            assert (skipped + len(stream)) % 64 == 0
+            words = []
+            for off in range(0, len(stream), 4):
+                quad = stream[off:off + 4]
+                if all(isinstance(b, int) for b in quad):
+                    words.append(shaw.constant_word(
+                        ctx, int.from_bytes(bytes(quad), "big")))
+                else:
+                    cells = [b if not isinstance(b, int)
+                             else ctx.load_constant(b) for b in quad]
+                    words.append(shaw.word_from_bytes_be(ctx, cells))
+            return words
+
+        # b0 = H(z_pad(64) || msg || lib || 0x00 || dst'): the all-zero
+        # z_pad block enters via the constant midstate
+        tail = list(msg_bytes) + [int(b) for b in lib + b"\x00" + dst_prime]
+        b0_words = shaw._compress_chain(
+            ctx, pack_words(tail, 64 + len(msg_bytes) + 3 + len(dst_prime), 64),
+            initial_state=list(_STATE_AFTER_ZERO_BLOCK))
+        b0 = [sha.word_from_cell(ctx, w.cell) for w in b0_words]
+
+        outs = []
+        prev = None
+        for i in range(1, ell + 1):
+            if i == 1:
+                first8 = b0
+            else:
+                first8 = []          # b0 XOR b_{i-1}, nibble-wise
+                for w0, wp in zip(b0, prev):
+                    nibs = sha._nib_op(ctx, XOR_OP, w0.nibs, wp.nibs)
+                    first8.append(sha._recompose(ctx, nibs))
+            tail = [int(b) for b in bytes([i]) + dst_prime]
+            # total message = 32 (first8 words) + 1 + len(dst'); skipped=32
+            # accounts for the first8 words already in the stream
+            words = list(first8) + pack_words(tail, 32 + 1 + len(dst_prime), 32)
+            prev_words = shaw._compress_chain(ctx, words)
+            prev = [sha.word_from_cell(ctx, w.cell) for w in prev_words]
             outs.append(prev)
         return outs
 
@@ -139,7 +212,9 @@ class HashToCurveChip:
 
     def hash_to_field_fq2(self, ctx: Context, msg_bytes: list,
                           dst: bytes, count: int = 2) -> list:
-        digests = self.expand_message_xmd(ctx, msg_bytes, dst, count * 128)
+        expand = (self.expand_message_xmd_wide if self.sha_wide is not None
+                  else self.expand_message_xmd)
+        digests = expand(ctx, msg_bytes, dst, count * 128)
         return [(self._digests_to_fq(ctx, digests[4 * i], digests[4 * i + 1]),
                  self._digests_to_fq(ctx, digests[4 * i + 2], digests[4 * i + 3]))
                 for i in range(count)]

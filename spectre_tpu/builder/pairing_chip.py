@@ -54,40 +54,17 @@ class PairingChip:
         return c0, c3, c5
 
     def _double_step(self, ctx: Context, t_pt) -> tuple:
-        """(2T, tangent slope): lam * 2y = 3x^2; lazy point formulas."""
-        fp2, lz = self.fp2, self.lz
-        x, y = t_pt
-        x2 = fp2.square(ctx, x)
-        lam = fp2.div_unsafe(ctx, fp2.mul_scalar(ctx, x2, 3),
-                             fp2.mul_scalar(ctx, y, 2))
-        lam2 = lz.mul(ctx, lam, lam)
-        x3 = lz.reduce(ctx, lz.sub(ctx, lz.sub(ctx, lam2, lz.lift(ctx, x)),
-                                   lz.lift(ctx, x)))
-        xd = fp2.sub(ctx, x, x3)
-        y3 = lz.reduce(ctx, lz.sub(ctx, lz.mul(ctx, lam, xd),
-                                   lz.lift(ctx, y)))
-        return (x3, y3), lam
+        """(2T, tangent slope): 2·(λ·y) ≡ 3x² constrained lazily
+        (G2Chip.double_core)."""
+        return self.g2.double_core(ctx, t_pt)
 
     def _add_step(self, ctx: Context, t_pt, q_pt, strict: bool = True) -> tuple:
-        """(T+Q, chord slope). strict constrains x_T != x_Q; pass False only
-        where T is itself fully constraint-determined (e.g. deterministic
-        ladders over a pinned input), where dx != 0 as witnessed values
-        already pins the slope uniquely."""
-        fp2, lz = self.fp2, self.lz
-        xt, yt = t_pt
-        xq, yq = q_pt
-        dx = fp2.sub(ctx, xt, xq)
-        if strict:
-            fp2.assert_nonzero(ctx, dx)
-        dy = fp2.sub(ctx, yt, yq)
-        lam = fp2.div_unsafe(ctx, dy, dx)
-        lam2 = lz.mul(ctx, lam, lam)
-        x3 = lz.reduce(ctx, lz.sub(ctx, lz.sub(ctx, lam2, lz.lift(ctx, xt)),
-                                   lz.lift(ctx, xq)))
-        xd = fp2.sub(ctx, xt, x3)
-        y3 = lz.reduce(ctx, lz.sub(ctx, lz.mul(ctx, lam, xd),
-                                   lz.lift(ctx, yt)))
-        return (x3, y3), lam
+        """(T+Q, chord slope; G2Chip.add_core). strict constrains
+        x_T != x_Q; pass False only where T is itself fully
+        constraint-determined (e.g. deterministic ladders over a pinned
+        input), where dx != 0 as witnessed values already pins the slope
+        uniquely."""
+        return self.g2.add_core(ctx, t_pt, q_pt, strict=strict)
 
     def _sparse_to_fp12(self, ctx: Context, c0, c3, c5) -> tuple:
         zero = self.fp2.load_constant(ctx, (0, 0))

@@ -131,14 +131,16 @@ class Sha256WideChip(Sha256Chip):
         wcols[: SHA_OUT_ROW + 1, SHA_ACT_WORD] = 1
         return h_out
 
-    def _compress_chain(self, ctx: Context, word_cells: list):
-        """Run len(word_cells)/16 chained blocks from the IV; word_cells are
-        main-region cells (witness or constant) of the padded message.
-        Returns 8 WideWords mirroring the final H_out."""
+    def _compress_chain(self, ctx: Context, word_cells: list,
+                        initial_state: list | None = None):
+        """Run len(word_cells)/16 chained blocks from the IV (or a caller
+        constant midstate, e.g. expand_message_xmd's all-zero z_pad block);
+        word_cells are main-region cells (witness or constant) of the padded
+        message. Returns 8 WideWords mirroring the final H_out."""
         assert len(word_cells) % 16 == 0
         nblocks = len(word_cells) // 16
         copies = ctx.copies
-        state = [int(v) for v in H0]
+        state = [int(v) for v in (initial_state or H0)]
         prev_slot = None
         for b in range(nblocks):
             blk = word_cells[16 * b:16 * b + 16]

@@ -355,7 +355,11 @@ def gen_evm_verifier(vk: VerifyingKey, srs: SRS, num_instances: int,
     else:
         # --- deferred KZG accumulator pairing (aggregation statements) ---
         assert num_acc_limbs == 12, "accumulator layout is 12 x 88-bit limbs"
-        L('require(_pairing(pin), "outer pairing");')
+        # the pairing and accumulator-limb checks return false (not revert)
+        # so both the plain and accumulator paths agree on how an invalid
+        # final check reports; structural requires (lengths, canonicity)
+        # still revert in both paths
+        L("if (!_pairing(pin)) { return false; }")
         L("// deferred accumulator: e(accL, [tau]_2) * e(-accR, [1]_2) == 1")
         for c, name in enumerate(["aLx", "aLy", "aRx", "aRy"]):
             terms = " + ".join(
@@ -365,11 +369,11 @@ def gen_evm_verifier(vk: VerifyingKey, srs: SRS, num_instances: int,
             # limb ranges so the shifted sum cannot wrap uint256 (top limb
             # < 2^80 since 80 + 176 = 256); the coord < Q check then pins
             # the canonical value
-            L(f"require(instances[{3 * c}] < (1 << 88) && "
+            L(f"if (!(instances[{3 * c}] < (1 << 88) && "
               f"instances[{3 * c + 1}] < (1 << 88) && "
-              f"instances[{3 * c + 2}] < (1 << 80), \"acc limb range\");")
+              f"instances[{3 * c + 2}] < (1 << 80))) {{ return false; }}")
             L(f"uint256 {name} = {terms};")
-            L(f"require({name} < Q_MOD, \"acc coord range\");")
+            L(f"if (!({name} < Q_MOD)) {{ return false; }}")
         L("uint256[2] memory negAccR = _negPt([aRx, aRy]);")
         for i, val in enumerate(
                 ["aLx", "aLy",

@@ -100,6 +100,13 @@ def _stmt(s: str) -> str:
     if m:
         cond = m.group(1).replace("&&", "and")
         return f"assert {cond}, {m.group(2)!r}"
+    # guard returns: if (!cond) { return false; }
+    m = re.match(r"if \(!(.*)\) \{ return false; \}$", s)
+    if m:
+        cond = m.group(1).replace("&&", "and")
+        return f"if not ({cond}): return False"
+    s = s.replace("return false", "return False").replace(
+        "return true", "return True")
     assert "uint256[" not in s, f"untranslated: {s}"
     return s
 

@@ -152,11 +152,18 @@ def g1_to_bytes(pt) -> bytes:
 
 
 def g1_from_bytes(b: bytes):
-    assert len(b) == 64
+    # explicit raises: deserializes untrusted proof/SRS bytes and must
+    # reject under `python -O` (asserts stripped) as well
+    if len(b) != 64:
+        raise ValueError("g1 point must be 64 bytes")
     if b == b"\x00" * 64:
         return None
-    pt = (Fq(int.from_bytes(b[:32], "big")), Fq(int.from_bytes(b[32:], "big")))
-    assert g1_curve.is_on_curve(pt)
+    x, y = int.from_bytes(b[:32], "big"), int.from_bytes(b[32:], "big")
+    if x >= P or y >= P:
+        raise ValueError("non-canonical g1 coordinate")
+    pt = (Fq(x), Fq(y))
+    if not g1_curve.is_on_curve(pt):
+        raise ValueError("g1 point not on curve")
     return pt
 
 
@@ -170,11 +177,16 @@ def g2_to_bytes(pt) -> bytes:
 
 
 def g2_from_bytes(b: bytes):
-    assert len(b) == 128
+    if len(b) != 128:
+        raise ValueError("g2 point must be 128 bytes")
     if b == b"\x00" * 128:
         return None
-    x = Fq2([int.from_bytes(b[32:64], "big"), int.from_bytes(b[:32], "big")])
-    y = Fq2([int.from_bytes(b[96:128], "big"), int.from_bytes(b[64:96], "big")])
+    ws = [int.from_bytes(b[i:i + 32], "big") for i in range(0, 128, 32)]
+    if any(w >= P for w in ws):
+        raise ValueError("non-canonical g2 coordinate")
+    x = Fq2([ws[1], ws[0]])
+    y = Fq2([ws[3], ws[2]])
     pt = (x, y)
-    assert g2_curve.is_on_curve(pt)
+    if not g2_curve.is_on_curve(pt):
+        raise ValueError("g2 point not on curve")
     return pt

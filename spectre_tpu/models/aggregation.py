@@ -84,13 +84,35 @@ def accumulate(accs: list[Accumulator]) -> Accumulator:
 
 
 @dataclass
+class SnarkWitness:
+    """One inner snark: its verifying key, public inputs, and proof bytes
+    (reference: snark-verifier-sdk's `Snark` — the unit the aggregation
+    circuit consumes)."""
+
+    vk: object                  # plonk VerifyingKey
+    instances: list             # [[int]] public inputs
+    proof: bytes                # Poseidon-transcript proof
+
+
+@dataclass
 class AggregationArgs:
-    """Witness for one compression layer: the inner proof and its context."""
+    """Witness for one compression layer: the inner proof(s) and context.
+
+    Single-snark compression (the service's two-stage flow) uses the first
+    four fields; `more_snarks` adds further inner proofs, RLC-folded into
+    ONE deferred accumulator with transcript-bound challenges (reference:
+    `AggregationCircuit::new(Vec<Snark>)` aggregating N snarks)."""
 
     inner_vk: object            # plonk VerifyingKey of the app circuit
     srs: SRS
     inner_instances: list       # [[int]] app public inputs
     proof: bytes                # Poseidon-transcript app proof
+    more_snarks: tuple = ()     # additional SnarkWitness entries
+
+    @property
+    def snarks(self) -> list:
+        return [SnarkWitness(self.inner_vk, self.inner_instances,
+                             self.proof)] + list(self.more_snarks)
 
 
 class AggregationCircuit(AppCircuit):
@@ -117,10 +139,17 @@ class AggregationCircuit(AppCircuit):
         from ..plonk.in_circuit import VerifierChip
         rng = RangeChip(lookup_bits=cls.default_lookup_bits)
         vc = VerifierChip(rng)
-        inst_cells = [[ctx.load_witness(int(v) % R) for v in col]
-                      for col in args.inner_instances]
-        lhs, rhs = vc.verify_proof(ctx, args.inner_vk, args.srs,
-                                   inst_cells, args.proof)
+        accs, all_inst_cells = [], []
+        for sn in args.snarks:
+            inst_cells = [[ctx.load_witness(int(v) % R) for v in col]
+                          for col in sn.instances]
+            all_inst_cells.append(inst_cells)
+            accs.append(vc.verify_proof(ctx, sn.vk, args.srs,
+                                        inst_cells, sn.proof))
+        if len(accs) == 1:
+            lhs, rhs = accs[0]
+        else:
+            lhs, rhs = vc.fold_accumulators(ctx, accs)
         # accumulator limbs: canonical representatives (the statement is
         # compared coordinate-for-coordinate by the outer pairing check)
         out = []
@@ -130,18 +159,24 @@ class AggregationCircuit(AppCircuit):
                 out.extend(can.limbs)
         for cell in out:
             ctx.expose_public(cell)
-        for col in inst_cells:
-            for cell in col:
-                ctx.expose_public(cell)
+        for inst_cells in all_inst_cells:
+            for col in inst_cells:
+                for cell in col:
+                    ctx.expose_public(cell)
         return out
 
     @classmethod
     def get_instances(cls, args: AggregationArgs, spec) -> list:
         from ..plonk.in_circuit import VerifierChip
-        acc = VerifierChip.native_accumulator(
-            args.inner_vk, args.srs, args.inner_instances, args.proof)
-        assert acc is not None, "inner proof invalid"
-        flat = [int(v) % R for col in args.inner_instances for v in col]
+        accs = []
+        for sn in args.snarks:
+            acc = VerifierChip.native_accumulator(
+                sn.vk, args.srs, sn.instances, sn.proof)
+            assert acc is not None, "inner proof invalid"
+            accs.append(acc)
+        acc = accs[0] if len(accs) == 1 else accumulate(accs)
+        flat = [int(v) % R for sn in args.snarks
+                for col in sn.instances for v in col]
         return acc.limbs() + flat
 
     @classmethod

@@ -66,9 +66,9 @@ class StepCircuit(AppCircuit):
               native_precheck: bool = True):
         gate = GateChip()
         rng = RangeChip(cls.default_lookup_bits, gate)
-        # SSZ/merkle/pub-input hashing runs in the wide region; the
-        # hash-to-curve expand_message keeps the nibble chip (its XOR
-        # plumbing works on nibble-decomposed words)
+        # SSZ/merkle/pub-input hashing AND the hash-to-curve
+        # expand_message compressions run in the wide region; the nibble
+        # chip keeps only the digest XOR mix + nibble recompositions
         sha = Sha256WideChip(gate)
         sha_nib = Sha256Chip(gate)
         poseidon = PoseidonChip(gate)
@@ -77,7 +77,7 @@ class StepCircuit(AppCircuit):
         ecc = EccChip(fp)
         g2 = G2Chip(fp2)
         pairing = PairingChip(Fp12Chip(fp2))
-        h2c = HashToCurveChip(pairing, sha_nib)
+        h2c = HashToCurveChip(pairing, sha_nib, sha_wide=sha)
         n = spec.sync_committee_size
         assert len(args.pubkeys_uncompressed) == n
         assert len(args.participation_bits) == n

@@ -105,8 +105,20 @@ class CircuitConfig:
         return 1 << self.k
 
     @property
+    def zk_rows(self) -> int:
+        # keep blinding rows strictly above the max per-column open count
+        # (halo2's blinding_factors >= queries + 1 margin): the wide-SHA shb
+        # columns are opened at 5 rotations, so SHA configs blind 7 rows.
+        # NOT 6: zk_rows=6 puts last_row at n-7, whose rotation point
+        # omega^(n-7)·x coincides with the SHA w-ladder's rot -7 query —
+        # the tag-keyed SHPLONK consumers (in-circuit verifier, EVM codegen)
+        # would then disagree with the value-deduping native verifier
+        # (keygen asserts this injectivity).
+        return ZK_ROWS + 2 if self.num_sha_slots else ZK_ROWS
+
+    @property
     def usable_rows(self) -> int:
-        return self.n - ZK_ROWS - 1
+        return self.n - self.zk_rows - 1
 
     @property
     def last_row(self) -> int:

@@ -116,7 +116,6 @@ def sha_expressions(cfg: CircuitConfig, c):
                                     SHA_W)
 
     exprs = []
-    one = c.const(1)
 
     def w(i, rot=0):
         return c.var(("shb", SHA_W + i), rot)

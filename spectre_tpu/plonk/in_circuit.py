@@ -308,6 +308,29 @@ class VerifierChip:
         tr.assert_consumed()
         return w2, acc_rhs
 
+    def fold_accumulators(self, ctx: Context, accs: list):
+        """RLC-fold N deferred-pairing accumulators into one, with the
+        challenges drawn from an in-circuit Poseidon transcript over the
+        (canonicalized) accumulator points — the cell-for-cell mirror of
+        `models.aggregation.accumulate` (reference: snark-verifier's
+        accumulation scheme over multiple snarks). Returns (lhs, rhs)."""
+        tchip = TranscriptChip()
+        cans = []
+        for lhs, rhs in accs:
+            clhs = tuple(self.fq.canonicalize(ctx, c) for c in lhs)
+            crhs = tuple(self.fq.canonicalize(ctx, c) for c in rhs)
+            cans.append((clhs, crhs))
+            tchip.absorb_point_limbs(
+                ctx, list(clhs[0].limbs) + list(clhs[1].limbs))
+            tchip.absorb_point_limbs(
+                ctx, list(crhs[0].limbs) + list(crhs[1].limbs))
+        rs = [tchip.challenge(ctx) for _ in accs]
+        lhs = self.msm.msm(ctx, [(cans[i][0], rs[i])
+                                 for i in range(len(accs))], [])
+        rhs = self.msm.msm(ctx, [(cans[i][1], rs[i])
+                                 for i in range(len(accs))], [])
+        return lhs, rhs
+
     @staticmethod
     def native_accumulator(vk: VerifyingKey, srs: SRS, instances: list,
                            proof: bytes):

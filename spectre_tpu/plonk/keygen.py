@@ -154,6 +154,23 @@ class VerifyingKey:
             plan.append((("h", i), 0))
         return plan
 
+    def assert_rotation_injective(self):
+        """Distinct rotation TAGS in the query plan must evaluate to
+        distinct points omega^rot · x: the in-circuit verifier and the EVM
+        codegen key the SHPLONK sets by tag, while the native verifier
+        dedupes by value — a collision (e.g. last_row ≡ a negative region
+        rotation mod n) silently desynchronizes them."""
+        dom = self.domain
+        seen = {}
+        for _key, rot in self.query_plan():
+            idx = self.config.last_row if rot == ROT_LAST else rot % dom.n
+            w = pow(dom.omega, idx, R)
+            prev = seen.setdefault(w, rot)
+            assert prev == rot or (isinstance(prev, int) and isinstance(rot, int)
+                                   and prev % dom.n == rot % dom.n), \
+                f"rotation tags {prev} and {rot} share omega^rot (zk_rows " \
+                f"collision — adjust CircuitConfig.zk_rows)"
+
     def rotation_point(self, x: int, rot) -> int:
         dom = self.domain
         if rot == ROT_LAST:
@@ -230,6 +247,7 @@ def keygen(srs: SRS, cfg: CircuitConfig, fixed_columns: list, selectors: list,
         sha_selector_commits=sha_sel_commits,
         sha_k_commit=sha_k_commit,
     )
+    vk.assert_rotation_injective()
     return ProvingKey(vk, sel_polys, fix_polys, sig_polys, tab_polys,
                       sel_vals, fix_vals, sigma_vals, tab_vals,
                       sha_selector_polys=sha_sel_polys,

@@ -110,14 +110,19 @@ class _TranscriptBase:
         return pt
 
     def read_scalar(self) -> int:
+        # explicit raises (not asserts): the parse path handles untrusted
+        # proof bytes and must reject under `python -O` too
         v = int.from_bytes(self._take(32), "big")
-        assert v < R, "non-canonical scalar in proof"
+        if v >= R:
+            raise ValueError("non-canonical scalar in proof")
         self.common_scalar(v)
         return v
 
     def _take(self, n: int) -> bytes:
-        assert self._read_buf is not None, "read on a write transcript"
-        assert self._read_pos + n <= len(self._read_buf), "proof too short"
+        if self._read_buf is None:
+            raise ValueError("read on a write transcript")
+        if self._read_pos + n > len(self._read_buf):
+            raise ValueError("proof too short")
         out = self._read_buf[self._read_pos:self._read_pos + n]
         self._read_pos += n
         return out
@@ -127,8 +132,8 @@ class _TranscriptBase:
         return bytes(self._proof)
 
     def assert_consumed(self):
-        assert self._read_buf is not None and self._read_pos == len(self._read_buf), \
-            "proof has trailing bytes"
+        if self._read_buf is None or self._read_pos != len(self._read_buf):
+            raise ValueError("proof has trailing bytes")
 
     # -- squeeze --
     def challenge(self) -> int:

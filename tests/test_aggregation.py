@@ -178,6 +178,69 @@ class TestInCircuitVerifier:
         assert stmt[12:] == [int(v) % R for col in instances for v in col]
 
 
+@pytest.fixture(scope="module")
+def inner2():
+    """A second app circuit (different shape/vk) for multi-snark folds."""
+    random.seed(8)
+    ctx = Context()
+    rng = RangeChip(lookup_bits=8)
+    g = rng.gate
+    a = ctx.load_witness(31)
+    b = ctx.load_witness(64)
+    c = g.add(ctx, g.mul(ctx, a, a), b)
+    rng.range_check(ctx, c, 12)
+    ctx.expose_public(c)
+    cfg = ctx.auto_config(k=10, lookup_bits=8)
+    asg = ctx.assignment(cfg)
+    srs = SRS.unsafe_setup(10)
+    pk = keygen(srs, cfg, asg.fixed, asg.selectors, asg.copies)
+    proof = prove(pk, srs, asg, transcript=PoseidonTranscript())
+    return pk, srs, asg.instances, proof
+
+
+class TestMultiSnarkFold:
+    def test_fold_matches_native_accumulate(self, inner, inner2):
+        """Two inner snarks (distinct vks) verified in-circuit; the
+        transcript-bound RLC fold equals the native `accumulate` and the
+        folded deferred pairing closes (reference: snark-verifier
+        aggregating Vec<Snark> with N > 1)."""
+        from spectre_tpu.models.aggregation import SnarkWitness
+
+        pk1, srs, inst1, proof1 = inner
+        pk2, _srs2, inst2, proof2 = inner2
+        a1 = VerifierChip.native_accumulator(pk1.vk, srs, inst1, proof1)
+        a2 = VerifierChip.native_accumulator(pk2.vk, srs, inst2, proof2)
+        want = accumulate([a1, a2])
+        assert want.check(srs)
+
+        ctx = Context()
+        vc = VerifierChip(RangeChip(lookup_bits=14))
+        accs = []
+        for pk, inst, proof in ((pk1, inst1, proof1), (pk2, inst2, proof2)):
+            cells = [[ctx.load_witness(int(v)) for v in col] for col in inst]
+            accs.append(vc.verify_proof(ctx, pk.vk, srs, cells, proof))
+        lhs, rhs = vc.fold_accumulators(ctx, accs)
+        assert (lhs[0].value % P, lhs[1].value % P) == \
+            (int(want.lhs[0]), int(want.lhs[1]))
+        assert (rhs[0].value % P, rhs[1].value % P) == \
+            (int(want.rhs[0]), int(want.rhs[1]))
+
+    def test_multi_snark_statement_layout(self, inner, inner2):
+        from spectre_tpu.models.aggregation import SnarkWitness
+
+        pk1, srs, inst1, proof1 = inner
+        pk2, _srs2, inst2, proof2 = inner2
+        args = AggregationArgs(
+            inner_vk=pk1.vk, srs=srs, inner_instances=inst1, proof=proof1,
+            more_snarks=(SnarkWitness(pk2.vk, inst2, proof2),))
+        stmt = AggregationCircuit.get_instances(args, None)
+        n1 = sum(len(c) for c in inst1)
+        n2 = sum(len(c) for c in inst2)
+        assert len(stmt) == 12 + n1 + n2
+        acc = Accumulator.from_limbs(stmt[:12])
+        assert acc.check(srs)
+
+
 @pytest.mark.skipif(not RUN_SLOW, reason="~6M-cell mock (set RUN_SLOW=1)")
 class TestAggregationCircuitSlow:
     def test_mock_satisfied(self, inner):
