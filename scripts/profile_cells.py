@@ -50,7 +50,7 @@ P1 = bls.g1_curve.mul(G1, 5)
 
 
 def main():
-    which = sys.argv[1:] or ["fp", "g1", "g2", "fp12", "miller", "finalexp",
+    which = sys.argv[1:] or ["fp", "g1", "g2", "fp12", "miller",
                              "pairing2", "subgroup", "h2c"]
     C = {}
     if "fp" in which:
@@ -83,12 +83,6 @@ def main():
             q = k["g2"].load_point(c, P2)
             k["pairing"].multi_miller_loop(c, [(p, q)])
         cost("miller_loop 1 pair", ml)
-    if "finalexp" in which:
-        def fe(c, k):
-            a = k["fp12"].load(c, bls.pairing(P2, P1))
-            k["pairing"].assert_final_exp_one_unsafe(c, a) \
-                if hasattr(k["pairing"], "assert_final_exp_one_unsafe") else None
-        # final exp measured within pairing2 below if no direct API
     if "pairing2" in which:
         def p2(c, k):
             p = k["ecc"].load_point(c, P1)

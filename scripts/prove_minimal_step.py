@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""End-to-end REAL prove of the sync-step circuit at Minimal (32 validators).
+
+Round-1 VERDICT item 7 / round-3 plan: demonstrate the flagship circuit at a
+reference spec preset (not just the tiny demo net), full in-circuit BLS block
+included. Run: JAX_PLATFORMS=cpu SPECTRE_TRACE=1 python scripts/prove_minimal_step.py [k]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from spectre_tpu.spec import MINIMAL
+from spectre_tpu.test_utils import default_sync_step_args
+from spectre_tpu.models.step import StepCircuit
+from spectre_tpu.plonk.srs import SRS
+
+
+def main():
+    k = int(sys.argv[1]) if len(sys.argv) > 1 else 18
+    t0 = time.time()
+    args = default_sync_step_args(MINIMAL)
+    print(f"[{time.time()-t0:7.1f}s] fixture ready (32 pubkeys, signed)",
+          flush=True)
+    srs = SRS.load_or_setup(k)
+    print(f"[{time.time()-t0:7.1f}s] srs k={k}", flush=True)
+    pk = StepCircuit.create_pk(srs, MINIMAL, k, args)
+    print(f"[{time.time()-t0:7.1f}s] pk ready", flush=True)
+    t1 = time.time()
+    proof = StepCircuit.prove(pk, srs, args, MINIMAL)
+    print(f"[{time.time()-t0:7.1f}s] PROOF DONE: {len(proof)} bytes "
+          f"(prove phase {time.time()-t1:.1f}s)", flush=True)
+    inst = StepCircuit.get_instances(args, MINIMAL)
+    ok = StepCircuit.verify(pk.vk, srs, inst, proof)
+    print(f"[{time.time()-t0:7.1f}s] verify: {ok}", flush=True)
+    assert ok
+
+
+if __name__ == "__main__":
+    main()

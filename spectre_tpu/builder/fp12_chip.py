@@ -124,6 +124,23 @@ class Fp12Chip:
                 s[k] = t if s[k] is None else lz.add(ctx, s[k], t)
         return self._fold_and_reduce(ctx, s)
 
+    def _sq4(self, ctx: Context, za, zb):
+        """Fp4 squaring (za + zb V)^2 = (za^2 + xi zb^2) + (2 za zb) V for
+        V = w^3, V^2 = xi — shared by the full Granger–Scott square and the
+        compressed-coordinate square."""
+        lz = self.lazy
+        ta = lz.mul(ctx, za, za)
+        tb = lz.mul(ctx, zb, zb)
+        zs = lz.add(ctx, lz.lift(ctx, za), lz.lift(ctx, zb))
+        ts = lz.mul(ctx, zs, zs)
+        tab = lz.sub(ctx, lz.sub(ctx, ts, ta), tb)
+        return lz.add(ctx, ta, lz.mul_by_xi(ctx, tb)), tab
+
+    def _two(self, ctx: Context, p):
+        """2x a reduced Fq2 pair, lazily."""
+        lz = self.lazy
+        return lz.scale(ctx, lz.lift(ctx, p), 2)
+
     def cyclotomic_square(self, ctx: Context, a) -> tuple:
         """Granger–Scott squaring, valid ONLY for elements of the cyclotomic
         subgroup (as everything after the final exponentiation's easy part
@@ -138,34 +155,86 @@ class Fp12Chip:
         (a non-cyclotomic input does NOT satisfy it; inputs here are
         constraint-forced into the subgroup by the easy part)."""
         lz = self.lazy
-        big = lz.big
-
-        def scale2(p, k):
-            return (big.scale_ovf(ctx, p[0], k), big.scale_ovf(ctx, p[1], k))
-
-        def two(p):
-            return scale2(lz.lift(ctx, p), 2)
-
-        def sq4(za, zb):
-            # (za + zb V)^2 = (za^2 + xi zb^2) + (2 za zb) V
-            ta = lz.mul(ctx, za, za)
-            tb = lz.mul(ctx, zb, zb)
-            zs = lz.add(ctx, lz.lift(ctx, za), lz.lift(ctx, zb))
-            ts = lz.mul(ctx, zs, zs)
-            tab = lz.sub(ctx, lz.sub(ctx, ts, ta), tb)
-            return lz.add(ctx, ta, lz.mul_by_xi(ctx, tb)), tab
+        sq4 = lambda za, zb: self._sq4(ctx, za, zb)
+        two = lambda p: self._two(ctx, p)
+        scale3 = lambda p: lz.scale(ctx, p, 3)
 
         z = a
         A0, A1 = sq4(z[0], z[3])
         B0, B1 = sq4(z[2], z[5])
         C0, C1 = sq4(z[1], z[4])
-        y0 = lz.sub(ctx, scale2(A0, 3), two(z[0]))
-        y3 = lz.add(ctx, scale2(A1, 3), two(z[3]))
-        y1 = lz.add(ctx, scale2(lz.mul_by_xi(ctx, B1), 3), two(z[1]))
-        y4 = lz.sub(ctx, scale2(B0, 3), two(z[4]))
-        y2 = lz.sub(ctx, scale2(C0, 3), two(z[2]))
-        y5 = lz.add(ctx, scale2(C1, 3), two(z[5]))
+        y0 = lz.sub(ctx, scale3(A0), two(z[0]))
+        y3 = lz.add(ctx, scale3(A1), two(z[3]))
+        y1 = lz.add(ctx, scale3(lz.mul_by_xi(ctx, B1)), two(z[1]))
+        y4 = lz.sub(ctx, scale3(B0), two(z[4]))
+        y2 = lz.sub(ctx, scale3(C0), two(z[2]))
+        y5 = lz.add(ctx, scale3(C1), two(z[5]))
         return tuple(lz.reduce(ctx, y) for y in (y0, y1, y2, y3, y4, y5))
+
+    # -- Karabina-style compressed cyclotomic squaring ------------------
+    # In this tower the coordinate set {c1, c2, c4, c5} is CLOSED under the
+    # Granger–Scott square map (y1,y2,y4,y5 depend only on z1,z2,z4,z5 —
+    # read off cyclotomic_square above), so long square runs in pow_abs_x
+    # carry 4 coefficients instead of 6: 6 Fq2 products + 8 reductions per
+    # square vs the full GS 9 + 12. Decompression recovers (c0, c3) from
+    # the unit-norm identity g·conj(g) = 1, which in v-coordinates
+    # (v = w², E = c0 + c2 v + c4 v², O = c1 + c3 v + c5 v²; E² − vO² = 1)
+    # yields the LINEAR system
+    #     2 c2·c0 − 2ξ c5·c3 = c1² − ξ c4²
+    #     2 c4·c0 − 2 c1·c3 = ξ c5² − c2²
+    # — witnessed (c0, c3), both equations constrained, and the system's
+    # determinant 4(ξ c4 c5 − c1 c2) constrained nonzero so the solution is
+    # pinned uniquely. Host-validated against the full tower square.
+
+    def _compressed_square(self, ctx: Context, comp) -> tuple:
+        """One squaring step on (c1, c2, c4, c5) of a cyclotomic element."""
+        lz = self.lazy
+        z1, z2, z4, z5 = comp
+        two = lambda p: self._two(ctx, p)
+        B0, B1 = self._sq4(ctx, z2, z5)
+        C0, C1 = self._sq4(ctx, z1, z4)
+        y1 = lz.add(ctx, lz.scale(ctx, lz.mul_by_xi(ctx, B1), 3), two(z1))
+        y4 = lz.sub(ctx, lz.scale(ctx, B0, 3), two(z4))
+        y2 = lz.sub(ctx, lz.scale(ctx, C0, 3), two(z2))
+        y5 = lz.add(ctx, lz.scale(ctx, C1, 3), two(z5))
+        return tuple(lz.reduce(ctx, y) for y in (y1, y2, y4, y5))
+
+    def _decompress(self, ctx: Context, comp) -> tuple:
+        """(c1, c2, c4, c5) -> full 6-tuple, recovering (c0, c3)."""
+        fp2, lz = self.fp2, self.lazy
+        z1, z2, z4, z5 = comp
+        XI_h = bls.Fq2([1, 1])
+        two_h = bls.Fq2([2, 0])
+        v1, v2, v4, v5 = (fp2.value(z) for z in comp)
+        a11, a12 = v2 * two_h, bls.Fq2([0, 0]) - XI_h * v5 * two_h
+        a21, a22 = v4 * two_h, bls.Fq2([0, 0]) - v1 * two_h
+        b1 = v1 * v1 - XI_h * v4 * v4
+        b2 = XI_h * v5 * v5 - v2 * v2
+        det = a11 * a22 - a12 * a21
+        assert det != bls.Fq2([0, 0]), "compressed element not decompressible"
+        c0 = fp2.load(ctx, (b1 * a22 - b2 * a12) / det)
+        c3 = fp2.load(ctx, (a11 * b2 - a21 * b1) / det)
+        # det != 0 pins (c0, c3) as the unique solution (reduce before the
+        # inverse product so the quotient stays within limb width)
+        det_cell = lz.reduce(
+            ctx, lz.sub(ctx, lz.mul_by_xi(ctx, lz.mul(ctx, z4, z5)),
+                        lz.mul(ctx, z1, z2)))
+        fp2.assert_nonzero(ctx, det_cell)
+        eq1 = lz.sub(
+            ctx,
+            lz.sub(ctx, lz.scale(ctx, lz.mul(ctx, z2, c0), 2),
+                   lz.scale(ctx, lz.mul_by_xi(ctx, lz.mul(ctx, z5, c3)), 2)),
+            lz.sub(ctx, lz.mul(ctx, z1, z1),
+                   lz.mul_by_xi(ctx, lz.mul(ctx, z4, z4))))
+        lz.assert_zero(ctx, eq1)
+        eq2 = lz.sub(
+            ctx,
+            lz.sub(ctx, lz.scale(ctx, lz.mul(ctx, z4, c0), 2),
+                   lz.scale(ctx, lz.mul(ctx, z1, c3), 2)),
+            lz.sub(ctx, lz.mul_by_xi(ctx, lz.mul(ctx, z5, z5)),
+                   lz.mul(ctx, z2, z2)))
+        lz.assert_zero(ctx, eq2)
+        return (c0, z1, z2, c3, z4, z5)
 
     def conjugate(self, ctx: Context, a) -> tuple:
         """f^(p^6): w -> -w (gamma6 = -1): negate odd slots."""
@@ -232,13 +301,38 @@ class Fp12Chip:
     def pow_abs_x(self, ctx: Context, a, cyclotomic: bool = False) -> tuple:
         """a^|x|, |x| = 0xd201000000010000 (square-and-multiply over the
         fixed bit pattern; bits 63,62,60,57,48,16). cyclotomic=True uses
-        Granger–Scott squaring — only valid for subgroup elements."""
+        Granger–Scott squaring, with square runs >= 3 carried in the
+        compressed (c1,c2,c4,c5) coordinates (see _compressed_square) —
+        only valid for subgroup elements."""
         absx = -bls.BLS_X
         bits = bin(absx)[2:]
-        sq = self.cyclotomic_square if cyclotomic else self.square
-        acc = a
+        if not cyclotomic:
+            acc = a
+            for bit in bits[1:]:
+                acc = self.square(ctx, acc)
+                if bit == "1":
+                    acc = self.mul(ctx, acc, a)
+            return acc
+        # runs of squares between multiplies: [(k squares, mul after?)]
+        runs = []
+        cnt = 0
         for bit in bits[1:]:
-            acc = sq(ctx, acc)
+            cnt += 1
             if bit == "1":
+                runs.append((cnt, True))
+                cnt = 0
+        if cnt:
+            runs.append((cnt, False))
+        acc = a
+        for k, mul_after in runs:
+            if k >= 3:   # decompression overhead (~2 squares) amortized
+                comp = (acc[1], acc[2], acc[4], acc[5])
+                for _ in range(k):
+                    comp = self._compressed_square(ctx, comp)
+                acc = self._decompress(ctx, comp)
+            else:
+                for _ in range(k):
+                    acc = self.cyclotomic_square(ctx, acc)
+            if mul_after:
                 acc = self.mul(ctx, acc, a)
         return acc

@@ -168,7 +168,6 @@ class Fp2Lazy:
 
     def assert_nonzero(self, ctx: Context, x) -> None:
         """Constrain a lazy pair != 0 via witnessed inverse: x*inv - 1 ≡ 0."""
-        from .bigint import OverflowInt
         big = self.big
         v = self.value(x)
         assert v != bls.Fq2([0, 0]), "assert_nonzero: witness is zero"
@@ -240,7 +239,6 @@ class G2Chip:
     def load_point(self, ctx: Context, pt) -> tuple:
         """On-curve check y^2 - x^3 - 4(1+u) ≡ 0, lazy (2 squares + 1 mul
         as convolutions, one intermediate reduction, 2 zero checks)."""
-        from .bigint import OverflowInt
         fp2 = self.fp2
         lz = fp2.lz
         x = fp2.load(ctx, pt[0])

@@ -224,8 +224,7 @@ def keygen(srs: SRS, cfg: CircuitConfig, fixed_columns: list, selectors: list,
     tab_poly_by_id = {tid: to_poly(v) for tid, v in tab_by_id.items()}
     tab_polys = [tab_poly_by_id[cfg.table_id(j)]
                  for j in range(cfg.num_lookup_advice)]
-    tab_commit_by_id = {tid: kzg.commit(srs, p, bk)
-                        for tid, p in tab_poly_by_id.items()}
+    tab_ids = sorted(tab_poly_by_id)
 
     sha_sel_polys, sha_k_poly = None, None
     sha_sel_commits, sha_k_commit = None, None
@@ -234,14 +233,33 @@ def keygen(srs: SRS, cfg: CircuitConfig, fixed_columns: list, selectors: list,
         sha_sel, sha_k = sha_selector_columns(cfg)
         sha_sel_polys = [to_poly(v) for v in sha_sel]
         sha_k_poly = to_poly(sha_k)
-        sha_sel_commits = [kzg.commit(srs, p, bk) for p in sha_sel_polys]
-        sha_k_commit = kzg.commit(srs, sha_k_poly, bk)
+
+    # all vk commitments in ONE batched backend call (device base cached,
+    # batch axis mesh-shardable — same machinery as the prover commit phase)
+    batch = (sel_polys + fix_polys + sig_polys
+             + [tab_poly_by_id[t] for t in tab_ids]
+             + (sha_sel_polys or [])
+             + ([sha_k_poly] if sha_k_poly is not None else []))
+    pts = kzg.commit_many(srs, batch, bk)
+    off = 0
+    def take(k):
+        nonlocal off
+        out = pts[off:off + k]
+        off += k
+        return out
+    sel_commits = take(len(sel_polys))
+    fix_commits = take(len(fix_polys))
+    sig_commits = take(len(sig_polys))
+    tab_commit_by_id = dict(zip(tab_ids, take(len(tab_ids))))
+    if cfg.num_sha_slots:
+        sha_sel_commits = take(len(sha_sel_polys))
+        sha_k_commit = take(1)[0]
 
     vk = VerifyingKey(
         config=cfg,
-        selector_commits=[kzg.commit(srs, p, bk) for p in sel_polys],
-        fixed_commits=[kzg.commit(srs, p, bk) for p in fix_polys],
-        sigma_commits=[kzg.commit(srs, p, bk) for p in sig_polys],
+        selector_commits=sel_commits,
+        fixed_commits=fix_commits,
+        sigma_commits=sig_commits,
         table_commits=[tab_commit_by_id[cfg.table_id(j)]
                        for j in range(cfg.num_lookup_advice)],
         sha_selector_commits=sha_sel_commits,

@@ -29,6 +29,15 @@ def commit(srs: SRS, coeffs: np.ndarray, bk=None):
     return bk.msm(srs.g1_powers, coeffs)
 
 
+def commit_many(srs: SRS, coeffs_list: list, bk=None) -> list:
+    """Commit to several coefficient-form polys in one backend call
+    (device base cached + batch axis shardable — SURVEY §2c(b))."""
+    bk = bk or B.get_backend()
+    for c in coeffs_list:
+        assert c.shape[0] <= srs.n, "poly larger than SRS"
+    return bk.msm_many(srs.g1_powers, coeffs_list)
+
+
 def commit_lagrange(srs: SRS, domain: Domain, evals: np.ndarray, bk=None):
     """Commit to lagrange-form poly (iNTT then power-basis MSM)."""
     bk = bk or B.get_backend()

@@ -128,32 +128,53 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
         pt = kzg.commit(srs, coeffs, bk)
         tr.write_point(pt)
 
-    with phase("prove/commit_advice"):
-        # pipelined commits (SURVEY §2c axis (c)): host-side limb
-        # marshalling of column i+1 overlaps the backend NTT+MSM of column
-        # i on a worker thread (ctypes/JAX release the GIL during backend
-        # calls). Transcript order is unchanged — results are consumed
-        # strictly in sequence.
+    COMMIT_CHUNK = 16   # bounds resident coefficient arrays (k=20: 512MB)
+
+    def commit_cols_batched(item_list):
+        """Pipelined + batched commits (SURVEY §2c axes (b)+(c)): host limb
+        marshalling of the NEXT chunk overlaps the backend NTT+MSM of the
+        current one on worker threads (ctypes/JAX release the GIL), and each
+        chunk's MSMs go through one `commit_many` call (device base cached;
+        batch axis sharded on a mesh). Transcript order is unchanged —
+        points are absorbed strictly in sequence."""
         from concurrent.futures import ThreadPoolExecutor
 
+        if not item_list:
+            return
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            futs = {i: ex.submit(B.to_arr, item_list[i][1])
+                    for i in range(min(COMMIT_CHUNK, len(item_list)))}
+            for base in range(0, len(item_list), COMMIT_CHUNK):
+                chunk = item_list[base:base + COMMIT_CHUNK]
+                for j in range(base + COMMIT_CHUNK,
+                               min(base + 2 * COMMIT_CHUNK, len(item_list))):
+                    if j not in futs:
+                        futs[j] = ex.submit(B.to_arr, item_list[j][1])
+                coeffs = []
+                for off, (key, vals) in enumerate(chunk):
+                    arr = futs.pop(base + off).result()
+                    c = dom.lagrange_to_coeff(arr, bk)
+                    values[key] = vals
+                    polys[key] = c
+                    coeffs.append(c)
+                for pt in kzg.commit_many(srs, coeffs, bk):
+                    tr.write_point(pt)
+
+    with phase("prove/commit_advice"):
         items = ([(("adv", j), v) for j, v in enumerate(adv_vals)]
                  + [(("ladv", j), v) for j, v in enumerate(ladv_vals)]
                  + [(("shb", j), v) for j, v in enumerate(shb_vals)]
                  + [(("shw", j), v) for j, v in enumerate(shw_vals)])
-        with ThreadPoolExecutor(max_workers=1) as ex:
-            fut = ex.submit(B.to_arr, items[0][1]) if items else None
-            for i, (key, vals) in enumerate(items):
-                arr = fut.result()
-                if i + 1 < len(items):
-                    fut = ex.submit(B.to_arr, items[i + 1][1])
-                commit_col(key, vals, arr=arr)
+        commit_cols_batched(items)
 
     # --- 2. lookup permuted columns ---
     with phase("prove/lookup_permute"):
+        lk_items = []
         for j in range(cfg.num_lookup_advice):
             pa, pt_col = permute_lookup(cfg, ladv_vals[j], pk.table_values[j])
-            commit_col(("pA", j), pa)
-            commit_col(("pT", j), pt_col)
+            lk_items.append(((("pA", j)), pa))
+            lk_items.append(((("pT", j)), pt_col))
+        commit_cols_batched(lk_items)
 
     beta = tr.challenge()
     gamma = tr.challenge()

@@ -28,6 +28,7 @@ class ProverState:
         dummy app snarks (`cli.rs:241-280`'s dummy-proof-at-setup)."""
         self.spec = spec
         self.backend = B.get_backend(backend)
+        self.concurrency = concurrency
         self.semaphore = threading.Semaphore(concurrency)
         self.srs = {}
         for k in {k_step, k_committee}:
@@ -95,6 +96,24 @@ class ProverState:
             proof = StepCircuit.prove(self.step_pk, self.srs[self.k_step],
                                       args, self.spec, self.backend)
         return proof, StepCircuit.get_instances(args, self.spec)
+
+    def prove_step_batch(self, args_list: list) -> list:
+        """Prove a batch of sync-step requests concurrently (SURVEY §2c(b)):
+        a pool sized by the concurrency governor; each worker still takes a
+        semaphore permit, so combined RPC + batch load honors one cap.
+        Witness generation runs in threads (builder work releases the GIL
+        during backend/numpy calls); commit-phase MSMs of concurrent proofs
+        share the backend's cached device base and the mesh batch axis."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=max(1, self.concurrency)) as ex:
+            return list(ex.map(self.prove_step, args_list))
+
+    def prove_committee_batch(self, args_list: list) -> list:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=max(1, self.concurrency)) as ex:
+            return list(ex.map(self.prove_committee, args_list))
 
     def prove_committee(self, args) -> tuple[bytes, list]:
         with self.semaphore:

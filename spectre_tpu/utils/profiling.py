@@ -26,9 +26,15 @@ def trace_enabled() -> bool:
     return os.environ.get("SPECTRE_TRACE", "") not in ("", "0")
 
 
+def _metrics_path() -> str | None:
+    return os.environ.get("SPECTRE_METRICS") or None
+
+
 @contextlib.contextmanager
 def phase(name: str):
-    """Time a prover phase; nestable."""
+    """Time a prover phase; nestable. SPECTRE_METRICS=<path> additionally
+    appends one JSON line per phase ({"phase", "seconds", "ts"}) — the
+    structured-metrics sink services/CI can scrape."""
     t0 = time.perf_counter()
     try:
         yield
@@ -38,6 +44,16 @@ def phase(name: str):
         _COUNTS[name] += 1
         if trace_enabled():
             print(f"[trace] {name}: {dt * 1000:.1f} ms", flush=True)
+        mp = _metrics_path()
+        if mp:
+            import json
+            try:
+                with open(mp, "a") as f:
+                    f.write(json.dumps({"phase": name,
+                                        "seconds": round(dt, 6),
+                                        "ts": round(time.time(), 3)}) + "\n")
+            except OSError:   # metrics must never break proving
+                pass
         log.debug("phase %s: %.1f ms", name, dt * 1000)
 
 

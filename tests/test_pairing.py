@@ -62,6 +62,17 @@ class TestFp12Chip:
         assert fp12.value(fp12.cyclotomic_square(ctx, a)) == t * t
         _mock(ctx, k=14)
 
+    def test_compressed_pow_abs_x_vs_host(self):
+        """pow_abs_x with Karabina-style compressed square runs (our-basis
+        closed set {c1,c2,c4,c5} + linear decompression from the unit-norm
+        identity) == host f^|x|, with a satisfied mock."""
+        ctx, fp, fp2, fp12 = _chips()
+        t = _rand_fq12() ** ((bls.P ** 6 - 1) * (bls.P ** 2 + 1))
+        a = fp12.load(ctx, t)
+        got = fp12.pow_abs_x(ctx, a, cyclotomic=True)
+        assert fp12.value(got) == t ** (-bls.BLS_X)
+        _mock(ctx, k=17)
+
     def test_frobenius_conjugate_inverse_vs_host(self):
         ctx, fp, fp2, fp12 = _chips()
         x = _rand_fq12()
