@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""REAL prove of the sync-step circuit at any spec preset.
+
+Usage: JAX_PLATFORMS=cpu SPECTRE_TRACE=1 python scripts/prove_step.py [spec] [k] [--mock]
+Defaults: spec=minimal k=18. `--mock` stops after mock satisfaction.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from spectre_tpu import spec as S
+from spectre_tpu.test_utils import default_sync_step_args
+from spectre_tpu.models.step import StepCircuit
+from spectre_tpu.plonk.srs import SRS
+
+
+def main():
+    args_v = [a for a in sys.argv[1:] if not a.startswith("--")]
+    spec = S.SPECS[args_v[0] if args_v else "minimal"]
+    k = int(args_v[1]) if len(args_v) > 1 else 18
+    mock_only = "--mock" in sys.argv
+    t0 = time.time()
+    args = default_sync_step_args(spec)
+    print(f"[{time.time()-t0:7.1f}s] fixture ready "
+          f"({spec.sync_committee_size} pubkeys, signed)", flush=True)
+    if mock_only:
+        ok = StepCircuit.mock(args, spec, k=k)
+        print(f"[{time.time()-t0:7.1f}s] MOCK: {ok}", flush=True)
+        assert ok
+        return
+    srs = SRS.load_or_setup(k)
+    print(f"[{time.time()-t0:7.1f}s] srs k={k}", flush=True)
+    pk = StepCircuit.create_pk(srs, spec, k, args)
+    print(f"[{time.time()-t0:7.1f}s] pk ready", flush=True)
+    t1 = time.time()
+    proof = StepCircuit.prove(pk, srs, args, spec)
+    print(f"[{time.time()-t0:7.1f}s] PROOF DONE: {len(proof)} bytes "
+          f"(prove phase {time.time()-t1:.1f}s)", flush=True)
+    inst = StepCircuit.get_instances(args, spec)
+    ok = StepCircuit.verify(pk.vk, srs, inst, proof)
+    print(f"[{time.time()-t0:7.1f}s] verify: {ok}", flush=True)
+    assert ok
+
+
+if __name__ == "__main__":
+    main()

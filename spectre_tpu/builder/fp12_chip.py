@@ -211,6 +211,11 @@ class Fp12Chip:
         b1 = v1 * v1 - XI_h * v4 * v4
         b2 = XI_h * v5 * v5 - v2 * v2
         det = a11 * a22 - a12 * a21
+        # det == 0 (xi c4 c5 == c1 c2) happens with probability ~2^-381 for
+        # the final-exp chain values of an honest witness, and a witness
+        # engineered to hit it only aborts ITS OWN proving (witness-time
+        # assert; constraint shape must stay witness-independent, so a
+        # dynamic fallback to full squares is not an option)
         assert det != bls.Fq2([0, 0]), "compressed element not decompressible"
         c0 = fp2.load(ctx, (b1 * a22 - b2 * a12) / det)
         c3 = fp2.load(ctx, (a11 * b2 - a21 * b1) / det)

@@ -99,6 +99,8 @@ class TpuBackend(CpuBackend):
     re-transferring the same 2^k-point base every call."""
 
     name = "tpu"
+    # quotient phase as one device-resident XLA program (quotient_device.py)
+    device_quotient = True
 
     def __init__(self):
         import jax  # noqa: F401  fail fast if jax unusable
@@ -186,14 +188,7 @@ class TpuBackend(CpuBackend):
                 sc[i, :mi] = np.asarray(L16.u64limbs_to_u16limbs(s[:mi]))
             res = batch_msm_dp(pts, sc)                    # [B, 3, 16]
             return list(ec.decode_points(np.asarray(res)))
-        out = []
-        for s in scalars_list:
-            m = min(points.shape[0], s.shape[0])
-            pts = self._base_points(points, m)
-            sc16 = jnp.asarray(L16.u64limbs_to_u16limbs(s[:m]))
-            res = MSM.msm(pts, sc16)
-            out.append(ec.decode_points(res[None])[0])
-        return out
+        return [self.msm(points, s) for s in scalars_list]
 
     def ntt(self, coeffs, omega: int):
         import jax.numpy as jnp

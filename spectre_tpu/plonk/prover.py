@@ -199,6 +199,7 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
 
     prev_end = 1
     nch = cfg.num_perm_chunks
+    gp_items = []    # pz + lz columns, committed in one batched call
     for ch in range(nch):
         cols = list(enumerate(col_keys))[ch * PERM_CHUNK:(ch + 1) * PERM_CHUNK]
         num = B.to_arr([1] * n)
@@ -228,7 +229,7 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
         # witness information halo2 hides. Randomize them.
         for i in range(u + 1, n):
             z[i] = secrets.randbelow(R)
-        commit_col(("pz", ch), z)
+        gp_items.append((("pz", ch), z))
     assert prev_end == 1, "permutation product != 1 (copy constraints unsatisfiable)"
 
     # --- 4. lookup grand products ---
@@ -238,11 +239,92 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
             values[("pT", j)], pk.table_values[j], beta, gamma)
         for i in range(u + 1, n):        # blind tail rows (see pz above)
             z[i] = secrets.randbelow(R)
-        commit_col(("lz", j), z)
+        gp_items.append((("lz", j), z))
+    # no challenge between pz and lz commits: one batched call
+    commit_cols_batched(gp_items)
 
     y = tr.challenge()
 
-    # --- 5. quotient on the extended coset ---
+    # instance polys (public-input binding in the identity) — both quotient
+    # paths and nothing else create them, so hoist before the dispatch
+    for j in range(cfg.num_instance):
+        polys[("inst", j)] = dom.lagrange_to_coeff(
+            B.to_arr(inst_vals[j]), bk)
+
+    def poly_for(key):
+        kind, j = key
+        if key in polys:
+            return polys[key]
+        if kind == "q":
+            return pk.selector_polys[j]
+        if kind == "fix":
+            return pk.fixed_polys[j]
+        if kind == "sig":
+            return pk.sigma_polys[j]
+        if kind == "tab":
+            return pk.table_polys[j]
+        if kind == "shq":
+            return pk.sha_selector_polys[j]
+        if kind == "shk":
+            return pk.sha_k_poly
+        raise KeyError(key)
+
+    if getattr(bk, "device_quotient", False):
+        # device-resident path: the whole identity as one jitted XLA
+        # program (quotient_device.py)
+        from .quotient_device import compute_quotient
+        with phase("prove/quotient"):
+            h_coeffs = compute_quotient(cfg, dom, poly_for, beta, gamma, y)
+    else:
+        h_coeffs = _quotient_host(cfg, dom, bk, pk, polys, beta, gamma, y)
+    # deg h <= 3n-4, so the top chunk must vanish. A nonzero tail means the
+    # division by the vanishing polynomial was inexact: either the witness
+    # violates a constraint, or an expression exceeded the degree-4 budget.
+    # Refusing here beats silently emitting an unverifiable proof.
+    assert not np.any(h_coeffs[3 * n:]), \
+        "quotient not a polynomial: witness violates constraints (or degree budget exceeded)"
+    h_chunks = []
+    for i in range(3):
+        chunk = h_coeffs[i * n:(i + 1) * n]
+        if chunk.shape[0] < n:
+            chunk = np.vstack([chunk, np.zeros((n - chunk.shape[0], 4), np.uint64)])
+        polys[("h", i)] = chunk
+        h_chunks.append(chunk)
+    for pt in kzg.commit_many(srs, h_chunks, bk):
+        tr.write_point(pt)
+
+    x = tr.challenge()
+
+    # --- 6. evaluations per the query plan ---
+    plan = pk.vk.query_plan()
+
+    with phase("prove/evals"):
+        evals = {}
+        for key, rot in plan:
+            pt = pk.vk.rotation_point(x, rot)
+            ev = host.fp_horner(host.FR, poly_for(key), pt)
+            evals[(key, rot)] = ev
+            tr.write_scalar(ev)
+
+    # --- 7. SHPLONK multiopen ---
+    by_key: dict = {}
+    for key, rot in plan:
+        by_key.setdefault(key, []).append(rot)
+    with phase("prove/multiopen"):
+        entries = []
+        for key, rots in by_key.items():
+            pts = tuple(pk.vk.rotation_point(x, r) for r in rots)
+            evs = tuple(evals[(key, r)] for r in rots)
+            entries.append(kzg.OpenEntry(poly_for(key), None, pts, evs))
+        kzg.shplonk_open(srs, dom, entries, tr, bk)
+
+    return tr.finalize()
+
+
+def _quotient_host(cfg, dom, bk, pk, polys, beta, gamma, y):
+    """The original host-orchestrated quotient: per-op backend calls over
+    the extended coset (CPU path)."""
+    n, u = cfg.n, cfg.usable_rows
     ext_cache: dict = {}
 
     def ext(key):
@@ -262,11 +344,8 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
                     pk.sha_selector_polys[key[1]], bk)
             elif key[0] == "shk":
                 ext_cache[key] = dom.coeff_to_extended(pk.sha_k_poly, bk)
-            elif key[0] == "inst":
-                coeffs = dom.lagrange_to_coeff(B.to_arr(inst_vals[key[1]]), bk)
-                polys[key] = coeffs
-                ext_cache[key] = dom.coeff_to_extended(coeffs, bk)
             else:
+                # ("inst", j) is pre-populated in polys by prove()
                 raise KeyError(key)
         return ext_cache[key]
 
@@ -298,61 +377,4 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
         for e in exprs:
             acc = e if acc is None else bk.add(bk.scale(acc, y), e)
         h_evals = bk.mul(acc, dom.vanishing_inv_on_extended())
-        h_coeffs = dom.extended_to_coeff(h_evals, bk)
-    # deg h <= 3n-4, so the top chunk must vanish. A nonzero tail means the
-    # division by the vanishing polynomial was inexact: either the witness
-    # violates a constraint, or an expression exceeded the degree-4 budget.
-    # Refusing here beats silently emitting an unverifiable proof.
-    assert not np.any(h_coeffs[3 * n:]), \
-        "quotient not a polynomial: witness violates constraints (or degree budget exceeded)"
-    for i in range(3):
-        chunk = h_coeffs[i * n:(i + 1) * n]
-        if chunk.shape[0] < n:
-            chunk = np.vstack([chunk, np.zeros((n - chunk.shape[0], 4), np.uint64)])
-        polys[("h", i)] = chunk
-        tr.write_point(kzg.commit(srs, chunk, bk))
-
-    x = tr.challenge()
-
-    # --- 6. evaluations per the query plan ---
-    plan = pk.vk.query_plan()
-
-    def poly_for(key):
-        kind, j = key
-        if key in polys:
-            return polys[key]
-        if kind == "q":
-            return pk.selector_polys[j]
-        if kind == "fix":
-            return pk.fixed_polys[j]
-        if kind == "sig":
-            return pk.sigma_polys[j]
-        if kind == "tab":
-            return pk.table_polys[j]
-        if kind == "shq":
-            return pk.sha_selector_polys[j]
-        if kind == "shk":
-            return pk.sha_k_poly
-        raise KeyError(key)
-
-    with phase("prove/evals"):
-        evals = {}
-        for key, rot in plan:
-            pt = pk.vk.rotation_point(x, rot)
-            ev = host.fp_horner(host.FR, poly_for(key), pt)
-            evals[(key, rot)] = ev
-            tr.write_scalar(ev)
-
-    # --- 7. SHPLONK multiopen ---
-    by_key: dict = {}
-    for key, rot in plan:
-        by_key.setdefault(key, []).append(rot)
-    with phase("prove/multiopen"):
-        entries = []
-        for key, rots in by_key.items():
-            pts = tuple(pk.vk.rotation_point(x, r) for r in rots)
-            evs = tuple(evals[(key, r)] for r in rots)
-            entries.append(kzg.OpenEntry(poly_for(key), None, pts, evs))
-        kzg.shplonk_open(srs, dom, entries, tr, bk)
-
-    return tr.finalize()
+        return dom.extended_to_coeff(h_evals, bk)

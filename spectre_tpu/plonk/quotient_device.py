@@ -1,0 +1,207 @@
+"""Device-resident quotient evaluation.
+
+The CPU prover evaluates `all_expressions` through the native batch backend
+(~6k sequential host calls over 32MB numpy arrays at k=18 — the dominant
+prove phase, 1067s of the 512-committee prove). TPU-first shape: every
+column is coset-NTT'd to the extended domain ON DEVICE and stays resident as
+a [4n, 16] Montgomery tensor; the expression tree, the y-fold, the vanishing
+division, and the inverse coset NTT all run as device ops with no host
+round-trips between them.
+
+Design note (learned the hard way): tracing the WHOLE tree into one jitted
+XLA program blows up LLVM codegen on the CPU backend (`Cannot allocate
+memory` from the execution engine at ~6k fused scan-heavy ops). The ops are
+therefore dispatched EAGERLY through a small set of jitted primitives
+(mont mul/add/sub, NTT) — data residency, not mega-fusion, is where the
+device win lives (each op is HBM-bandwidth-bound either way), and compile
+cost stays bounded per primitive shape.
+
+Parity: the device path produces EXACTLY the host path's u64 coefficient
+arrays, compared in-situ during real proves
+(tests/test_plonk.py::TestDeviceQuotient, gate+lookup and wide-SHA shapes).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..fields import bn254
+from .constraint_system import CircuitConfig
+from .domain import COSET_GEN, Domain
+from .expressions import all_expressions
+from .keygen import ROT_LAST
+
+R = bn254.R
+
+_jit_helpers: dict = {}
+_static_cache: dict = {}
+
+
+def _helpers():
+    """Jitted primitive ops, created once (stable trace cache)."""
+    if not _jit_helpers:
+        import functools
+
+        import jax
+
+        from ..ops import field_ops as F, ntt as NTT
+
+        fctx = F.fr_ctx()
+        _jit_helpers["to_mont"] = jax.jit(lambda v: F.to_mont(fctx, v))
+        _jit_helpers["from_mont"] = jax.jit(lambda v: F.from_mont(fctx, v))
+        _jit_helpers["mul"] = jax.jit(lambda a, b: F.mont_mul(fctx, a, b))
+        _jit_helpers["add"] = jax.jit(lambda a, b: F.add(fctx, a, b))
+        _jit_helpers["sub"] = jax.jit(lambda a, b: F.sub(fctx, a, b))
+        _jit_helpers["mul_s"] = jax.jit(
+            lambda a, s: F.mont_mul(fctx, a, s[None, :]))
+        _jit_helpers["add_s"] = jax.jit(
+            lambda a, s: F.add(fctx, a, s[None, :].repeat(a.shape[0], 0)))
+        _jit_helpers["fold"] = jax.jit(
+            lambda acc, y, e: F.add(fctx, F.mont_mul(fctx, acc, y[None, :]), e))
+
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def to_ext(coeffs16, coset_pow, omega_ext):
+            return NTT.ntt(F.mont_mul(fctx, coeffs16, coset_pow), omega_ext)
+
+        _jit_helpers["to_ext"] = to_ext
+
+        @functools.partial(jax.jit, static_argnums=(3,))
+        def h_from_acc(acc, vinv, inv_coset, omega_ext):
+            h = F.mont_mul(fctx, acc, vinv)
+            return F.mont_mul(fctx, NTT.intt(h, omega_ext), inv_coset)
+
+        _jit_helpers["h_from_acc"] = h_from_acc
+    return _jit_helpers
+
+
+class _DeviceCtx:
+    """all_expressions context over device-resident [m, 16] Montgomery
+    tensors, dispatching through the jitted primitives."""
+
+    def __init__(self, cols, m: int, last_row: int, mont_scalar):
+        self._h = _helpers()
+        self._cols = cols
+        self._m = m
+        self._last_row = last_row
+        self._mont = mont_scalar      # int -> [16] mont device scalar
+        self.l0 = cols[("_l0",)]
+        self.llast = cols[("_llast",)]
+        self.lblind = cols[("_lblind",)]
+        self.x_col = cols[("_xcol",)]
+
+    def var(self, key, rot):
+        import jax.numpy as jnp
+
+        arr = self._cols[key]
+        if rot == 0:
+            return arr
+        r = self._last_row if rot == ROT_LAST else rot
+        # extended-coset index shift: omega == omega_ext^EXTENSION
+        return jnp.roll(arr, -4 * r, axis=0)
+
+    def mul(self, a, b):
+        return self._h["mul"](a, b)
+
+    def add(self, a, b):
+        return self._h["add"](a, b)
+
+    def sub(self, a, b):
+        return self._h["sub"](a, b)
+
+    def scale(self, a, s):
+        return self._h["mul_s"](a, self._mont(s))
+
+    def add_const(self, a, s):
+        return self._h["add_s"](a, self._mont(s))
+
+    def const(self, s):
+        import jax.numpy as jnp
+
+        return jnp.broadcast_to(self._mont(s), (self._m, 16))
+
+
+def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
+                     beta: int, gamma: int, y: int) -> np.ndarray:
+    """Device quotient: returns h coefficients as [4n, 4] u64 standard form
+    (drop-in for the host path's extended_to_coeff output).
+
+    fetch_coeffs(key) -> [<=n, 4] u64 coefficient-form poly for every column
+    key the expression tree reads."""
+    import jax.numpy as jnp
+
+    from ..ops import limbs as L16
+    from . import backend as B
+
+    h = _helpers()
+    to_mont16 = h["to_mont"]
+    mont_of = lambda ints: to_mont16(
+        jnp.asarray(L16.u64limbs_to_u16limbs(B.to_arr(ints))))
+
+    _scalar_cache: dict = {}
+
+    def mont_scalar(s):
+        v = int(s) % R
+        if v not in _scalar_cache:
+            if len(_scalar_cache) > 4096:
+                _scalar_cache.clear()
+            _scalar_cache[v] = mont_of([v])[0]
+        return _scalar_cache[v]
+
+    # per-(cfg, domain) static device inputs: synthetic rows, coset scaling
+    # vectors, x column, vanishing inverse — built once, reused every proof
+    n, m = dom.n, dom.n_ext
+    ck = (cfg, dom.k)
+    st = _static_cache.get(ck)
+    if st is None:
+        def row_of(idx_vals):
+            vals = [0] * n
+            for i in idx_vals:
+                vals[i] = 1
+            return dom.lagrange_to_coeff(B.to_arr(vals))
+
+        st = {
+            "coset_pow": mont_of([pow(COSET_GEN, i, R) for i in range(m)]),
+            "inv_coset": mont_of(
+                [pow(pow(COSET_GEN, -1, R), i, R) for i in range(m)]),
+            "xcol": mont_of([COSET_GEN * pow(dom.omega_ext, i, R) % R
+                             for i in range(m)]),
+            "vinv": to_mont16(jnp.asarray(L16.u64limbs_to_u16limbs(
+                dom.vanishing_inv_on_extended()))),
+            "l0": row_of([0]),
+            "llast": row_of([cfg.last_row]),
+            "lblind": row_of(range(cfg.usable_rows + 1, n)),
+        }
+        if len(_static_cache) > 4:
+            _static_cache.clear()
+        _static_cache[ck] = st
+
+    def ext_of_coeffs(arr_u64):
+        padded = np.zeros((m, 4), dtype=np.uint64)
+        padded[:arr_u64.shape[0]] = arr_u64
+        return h["to_ext"](
+            to_mont16(jnp.asarray(L16.u64limbs_to_u16limbs(padded))),
+            st["coset_pow"], dom.omega_ext)
+
+    # lazily materialize only the columns the tree actually reads
+    cols: dict = {
+        ("_l0",): ext_of_coeffs(st["l0"]),
+        ("_llast",): ext_of_coeffs(st["llast"]),
+        ("_lblind",): ext_of_coeffs(st["lblind"]),
+        ("_xcol",): st["xcol"],
+    }
+
+    class LazyCols(dict):
+        def __missing__(self, key):
+            arr = ext_of_coeffs(fetch_coeffs(key))
+            self[key] = arr
+            return arr
+
+    ctx = _DeviceCtx(LazyCols(cols), m, cfg.last_row, mont_scalar)
+    exprs = all_expressions(cfg, ctx, beta, gamma)
+    acc = exprs[0]
+    y_m = mont_scalar(y)
+    for e in exprs[1:]:
+        acc = h["fold"](acc, y_m, e)
+    out = h["h_from_acc"](acc, st["vinv"], st["inv_coset"], dom.omega_ext)
+    std = h["from_mont"](out)
+    return L16.u16limbs_to_u64limbs(np.asarray(std))

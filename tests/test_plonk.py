@@ -337,6 +337,84 @@ class TestMockProver:
             mock_prove(cfg, asg)
 
 
+class TestDeviceQuotient:
+    """quotient_device.py: device-resident evaluation of the whole
+    constraint identity must match the host-orchestrated quotient EXACTLY
+    (same u64 coefficient arrays) — compared in-situ during a real prove
+    via a _quotient_host wrapper, so all inputs (blinds, grand products,
+    challenges) are the production ones."""
+
+    def _check(self, build_fn, k, lookup_bits, srs_k):
+        import spectre_tpu.plonk.prover as P
+        from spectre_tpu.builder.context import Context
+        from spectre_tpu.plonk.quotient_device import compute_quotient
+
+        ctx = Context()
+        build_fn(ctx)
+        cfg = ctx.auto_config(k=k, lookup_bits=lookup_bits)
+        asg = ctx.assignment(cfg)
+        srs_ = SRS.unsafe_setup(srs_k)
+        bk = B.get_backend("cpu")
+        pk = keygen(srs_, cfg, asg.fixed, asg.selectors, asg.copies, bk)
+        orig_q = P._quotient_host
+        res = {}
+
+        def wrapped(cfg_, dom_, bk_, pk_, polys_, beta, gamma, y):
+            h_host = orig_q(cfg_, dom_, bk_, pk_, polys_, beta, gamma, y)
+
+            def fetch(key):
+                kind, j = key
+                if key in polys_:
+                    return polys_[key]
+                if kind == "shk":
+                    return pk_.sha_k_poly
+                return {"q": pk_.selector_polys, "fix": pk_.fixed_polys,
+                        "sig": pk_.sigma_polys, "tab": pk_.table_polys,
+                        "shq": pk_.sha_selector_polys}[kind][j]
+
+            h_dev = compute_quotient(cfg_, dom_, fetch, beta, gamma, y)
+            res["equal"] = bool((h_host == h_dev).all())
+            return h_host
+
+        P._quotient_host = wrapped
+        try:
+            proof = P.prove(pk, srs_, asg, bk)
+        finally:
+            P._quotient_host = orig_q
+        assert verify(pk.vk, srs_, asg.instances, proof)
+        assert res["equal"], "device quotient != host quotient"
+
+    def test_gate_lookup_circuit(self):
+        from spectre_tpu.builder import RangeChip
+
+        def build(ctx):
+            rng = RangeChip(lookup_bits=4)
+            g = rng.gate
+            a = ctx.load_witness(5)
+            b = ctx.load_witness(9)
+            c = g.mul(ctx, a, b)
+            rng.range_check(ctx, a, 4)
+            ctx.expose_public(c)
+
+        self._check(build, k=5, lookup_bits=4, srs_k=7)
+
+    @pytest.mark.skipif(not os.environ.get("RUN_SLOW"),
+                        reason="device NTT compiles (set RUN_SLOW=1)")
+    def test_wide_sha_circuit(self):
+        """Region expressions, negative rotations, ROT_LAST, inst."""
+        from spectre_tpu.builder import GateChip
+        from spectre_tpu.builder.sha256_wide_chip import Sha256WideChip
+        from spectre_tpu.gadgets import ssz_merkle as M
+
+        def build(ctx):
+            sha = Sha256WideChip(GateChip())
+            cells = M.load_bytes_checked(ctx, sha, b"dq")
+            digest = sha.digest_bytes(ctx, cells)
+            ctx.expose_public(digest[0].cell)
+
+        self._check(build, k=9, lookup_bits=5, srs_k=11)
+
+
 @pytest.mark.skipif(not os.environ.get("RUN_SLOW"),
                     reason="minutes of device-kernel compile")
 class TestTpuBackendPath:
