@@ -17,14 +17,36 @@ from ..native import host
 R = bn254.R
 
 
+def _host_fingerprint() -> str:
+    """4-byte tag of this host's CPU feature flags. AOT entries compiled on
+    a machine with different features ABORT (SIGILL class) when loaded by
+    XLA:CPU — observed as `Fatal Python error: Aborted` inside _cache_read
+    when /tmp survived a host migration. Keying the cache dir by features
+    makes foreign entries unreachable instead of fatal."""
+    import hashlib
+    import platform
+    feat = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 "flags", aarch64 "Features"
+                if line.startswith(("flags", "Features")):
+                    feat = line.strip()
+                    break
+    except OSError:
+        pass
+    ident = f"{platform.machine()}|{feat}"
+    return hashlib.blake2s(ident.encode(), digest_size=4).hexdigest()
+
+
 def setup_compile_cache():
-    """Per-platform persistent JAX compile cache (shared policy for bench,
-    backends, and entry points; axon-remote AOT entries are not loadable by
-    the CPU backend, hence per-backend dirs)."""
+    """Per-platform, per-host-feature persistent JAX compile cache (shared
+    policy for bench, backends, tests, and entry points)."""
     import jax
     if not jax.config.jax_compilation_cache_dir:
-        jax.config.update("jax_compilation_cache_dir",
-                          f"/tmp/jax_cache_{jax.default_backend()}")
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            f"/tmp/jax_cache_{jax.default_backend()}_{_host_fingerprint()}")
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 

@@ -349,14 +349,21 @@ def _quotient_host(cfg, dom, bk, pk, polys, beta, gamma, y):
                 raise KeyError(key)
         return ext_cache[key]
 
+    rot_cache: dict = {}
+
     class LazyCtx(_ArrayCtx):
         def var(self, key, rot):
             arr = ext(key)
             if rot == 0:
                 return arr
-            if rot == ROT_LAST:
-                return dom.rotate_extended(arr, cfg.last_row)
-            return dom.rotate_extended(arr, rot)
+            # a (key, rot) pair is read by several expressions; rolling a
+            # 4n-row array per read was measurable quotient time
+            hit = rot_cache.get((key, rot))
+            if hit is None:
+                r = cfg.last_row if rot == ROT_LAST else rot
+                hit = dom.rotate_extended(arr, r)
+                rot_cache[(key, rot)] = hit
+            return hit
 
     ctx = LazyCtx(cfg, dom, bk, ext_cache)
     # l0 / l_last / l_blind on the extended coset

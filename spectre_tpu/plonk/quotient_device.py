@@ -84,6 +84,7 @@ class _DeviceCtx:
         self._m = m
         self._last_row = last_row
         self._mont = mont_scalar      # int -> [16] mont device scalar
+        self._rot_cache: dict = {}
         self.l0 = cols[("_l0",)]
         self.llast = cols[("_llast",)]
         self.lblind = cols[("_lblind",)]
@@ -95,9 +96,13 @@ class _DeviceCtx:
         arr = self._cols[key]
         if rot == 0:
             return arr
-        r = self._last_row if rot == ROT_LAST else rot
-        # extended-coset index shift: omega == omega_ext^EXTENSION
-        return jnp.roll(arr, -4 * r, axis=0)
+        hit = self._rot_cache.get((key, rot))
+        if hit is None:
+            r = self._last_row if rot == ROT_LAST else rot
+            # extended-coset index shift: omega == omega_ext^EXTENSION
+            hit = jnp.roll(arr, -4 * r, axis=0)
+            self._rot_cache[(key, rot)] = hit
+        return hit
 
     def mul(self, a, b):
         return self._h["mul"](a, b)

@@ -17,6 +17,9 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-# big circuit graphs compile slowly; persist compiled executables across runs
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cpu")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# big circuit graphs compile slowly; persist compiled executables across
+# runs. One shared policy (dir keyed by host CPU features — foreign AOT
+# entries ABORT at load): spectre_tpu.plonk.backend.setup_compile_cache.
+from spectre_tpu.plonk.backend import setup_compile_cache  # noqa: E402
+
+setup_compile_cache()
