@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""Generate the vendored consensus-spec-test fixture (official pyspec file
+format) for the Minimal preset. Deterministic; rerun to rebuild.
+
+Run: python scripts/gen_spec_test_fixture.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from spectre_tpu.preprocessor.spec_tests import generate_spec_test
+from spectre_tpu.spec import MINIMAL
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "consensus-spec-tests", "tests", "minimal", "capella",
+                   "light_client", "sync", "pyspec_tests",
+                   "light_client_sync_selfgen")
+
+if __name__ == "__main__":
+    generate_spec_test(OUT, MINIMAL)
+    print("wrote", OUT)
+    for f in sorted(os.listdir(OUT)):
+        print(" ", f, os.path.getsize(os.path.join(OUT, f)), "bytes")

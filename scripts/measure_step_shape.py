@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Measure the slimmed StepCircuit's cell budget + auto_config column counts.
+
+Run: python scripts/measure_step_shape.py [tiny|minimal|testnet]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from spectre_tpu.models import StepCircuit
+from spectre_tpu.spec import MINIMAL, TESTNET, TINY
+from spectre_tpu.witness.step import default_sync_step_args
+
+SPECS = {"tiny": TINY, "minimal": MINIMAL, "testnet": TESTNET}
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "tiny"
+    spec = SPECS[which]
+    args = default_sync_step_args(spec)
+    t0 = time.time()
+    ctx = StepCircuit.build_context(args, spec)
+    dt = time.time() - t0
+    st = ctx.stats()
+    print(f"spec={which} build={dt:.1f}s")
+    print(f"advice_cells={st['advice_cells']:,}")
+    print(f"lookup_cells={st['lookup_cells']}")
+    print(f"copies={st['copies']:,} constants={st['constants']:,}")
+    print(f"sha_slots={len(ctx.sha_slots)}")
+    for k in range(17, 23):
+        try:
+            cfg = ctx.auto_config(k=k, lookup_bits=StepCircuit.default_lookup_bits)
+        except AssertionError as e:
+            print(f"k={k}: {e}")
+            continue
+        print(f"k={k}: advice={cfg.num_advice} lookup_advice={cfg.num_lookup_advice} "
+              f"tables={cfg.lookup_tables} fixed={cfg.num_fixed} "
+              f"perm_cols={cfg.num_perm_columns}")
+
+
+if __name__ == "__main__":
+    main()

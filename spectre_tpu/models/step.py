@@ -60,24 +60,38 @@ def _fq_limbs(v: int):
 
 class StepCircuit(AppCircuit):
     name = "sync_step"
+    # The reference splits its SHA backends per circuit for exactly the
+    # reason we do: the step circuit is the one that gets COMPRESSED
+    # (in-circuit-verified by the aggregation layer), so its proof must
+    # stay small — the wide region adds 114 committed columns (+~550
+    # opening evals), which dwarfs the compression circuit. Step therefore
+    # uses the lookup ("flex") SHA chip (reference: `Sha256Chip` =
+    # sha256_flex, `sync_step_circuit.rs:71`), committee-update keeps the
+    # wide region (reference: `Sha256ChipWide`). The ~45k-cells/block cost
+    # of the 66 hashed blocks is bought back by lookup_bits=16 halving
+    # every range-check in the non-native BLS arithmetic (reference pins
+    # lookup_bits=20 at k=21 for the same reason,
+    # `config/sync_step_testnet.json`).
+    use_wide_sha = False
+    default_lookup_bits = 16
 
     @classmethod
     def build(cls, ctx: Context, args: SyncStepArgs, spec,
-              native_precheck: bool = True):
+              native_precheck: bool = True, use_wide_sha: bool | None = None):
+        if use_wide_sha is None:
+            use_wide_sha = cls.use_wide_sha
         gate = GateChip()
         rng = RangeChip(cls.default_lookup_bits, gate)
-        # SSZ/merkle/pub-input hashing AND the hash-to-curve
-        # expand_message compressions run in the wide region; the nibble
-        # chip keeps only the digest XOR mix + nibble recompositions
-        sha = Sha256WideChip(gate)
         sha_nib = Sha256Chip(gate)
+        sha = Sha256WideChip(gate) if use_wide_sha else sha_nib
         poseidon = PoseidonChip(gate)
         fp = FpChip(rng)
         fp2 = Fp2Chip(fp)
         ecc = EccChip(fp)
         g2 = G2Chip(fp2)
         pairing = PairingChip(Fp12Chip(fp2))
-        h2c = HashToCurveChip(pairing, sha_nib, sha_wide=sha)
+        h2c = HashToCurveChip(pairing, sha_nib,
+                              sha_wide=sha if use_wide_sha else None)
         n = spec.sync_committee_size
         assert len(args.pubkeys_uncompressed) == n
         assert len(args.participation_bits) == n

@@ -141,13 +141,13 @@ def generate_fixtures(spec, directory: str = "test_data", seed: int = 42):
 def read_spec_test_steps(test_dir: str):
     """Parse `steps.yaml` of a light_client/sync pyspec test into a list of
     (kind, payload) tuples (reference `test-utils/src/lib.rs:87-131` +
-    `test_types.rs`). Requires PyYAML and downloaded fixtures."""
-    import yaml  # type: ignore
+    `test_types.rs`). The full fixture pipeline (ssz_snappy containers ->
+    circuit witnesses) lives in `preprocessor.spec_tests`; this wrapper is
+    kept for step-sequence consumers."""
+    from .preprocessor.spec_tests import read_steps
 
-    with open(os.path.join(test_dir, "steps.yaml")) as f:
-        steps = yaml.safe_load(f)
     out = []
-    for step in steps:
+    for step in read_steps(test_dir):
         if "process_update" in step:
             out.append(("process_update", step["process_update"]))
         elif "force_update" in step:

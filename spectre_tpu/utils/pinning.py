@@ -43,7 +43,18 @@ class Pinning:
         context and persist (reference: written on first keygen,
         `util/circuit.rs:132-135`)."""
         if path and os.path.exists(path):
-            return cls.read(path)
+            pin = cls.read(path)
+            # a pinning written for a different circuit shape must not be
+            # silently reused: the layout would place the new witness into
+            # the old column plan and fail (at best) after a full prove
+            assert pin.config.lookup_bits == lookup_bits, \
+                f"pinned lookup_bits {pin.config.lookup_bits} != requested " \
+                f"{lookup_bits}: circuit shape changed — delete {path} (and " \
+                f"the matching .pk) to re-pin"
+            assert pin.config.num_sha_slots >= len(ctx.sha_slots), \
+                f"pinning has {pin.config.num_sha_slots} sha slots, circuit " \
+                f"uses {len(ctx.sha_slots)}: shape changed — delete {path}"
+            return pin
         cfg = ctx.auto_config(k=k, lookup_bits=lookup_bits)
         _, _, _, _, _, _, bp = ctx.layout(cfg)
         pin = cls(cfg, bp)
