@@ -109,7 +109,9 @@ class CommitteeUpdateCircuit(AppCircuit):
     def get_instances(cls, args: CommitteeUpdateArgs, spec) -> list:
         """Native recomputation (reference `get_instances:198`)."""
         from ..fields import bls12_381 as bls
-        pts = [bls.g1_decompress(pk) for pk in args.pubkeys_compressed]
+        from ..ops.field384 import g1_decompress_batch
+        pts = [(bls.Fq(x), bls.Fq(y)) for x, y in
+               g1_decompress_batch(list(args.pubkeys_compressed))]
         poseidon = PC.committee_poseidon_from_uncompressed(pts)
         root = args.finalized_header.hash_tree_root()
         lo = int.from_bytes(root[16:], "big")

@@ -639,33 +639,59 @@ extern "C" {
 // SRS generation: out[i] = tau^i * G, affine standard form [n, 8] limbs.
 // Sequential chain P_{i+1} = tau * P_i with jacobian double-and-add.
 void g1_scalar_powers(const u64* g_xy, const u64* tau, size_t n, u64* out) {
+  // P_i = tau^i * g via FIXED-BASE windowed multiplication: the scalars
+  // tau^i are cheap field muls, and one shared table of g-multiples
+  // (16 windows x 2^16 entries) turns every point into <= 16 additions —
+  // the previous per-power double-and-add was O(256) EC ops per point,
+  // which made 2^22+ SRS generation dominate setup wall-clock.
   spectre_init();
   const FpCtx& C = g_fq;
+  const FpCtx& Cr = g_fr;
   Fp gx, gy;
   std::memcpy(gx.v, g_xy, 32);
   std::memcpy(gy.v, g_xy + 4, 32);
-  G1 cur;
-  to_mont(cur.x, gx, C);
-  to_mont(cur.y, gy, C);
-  cur.z = C.one;
+  G1 base;
+  to_mont(base.x, gx, C);
+  to_mont(base.y, gy, C);
+  base.z = C.one;
+
+  // Window width from n (W must divide 64 so digits never straddle limbs).
+  // Total adds ~ (256/W) * (2^W + n): the break-evens are n=224 (4->8) and
+  // n=65024 (8->16) — small setups must not pay a 1M-add precompute.
+  const int W = n <= 224 ? 4 : n <= 65024 ? 8 : 16;
+  const int NW = 256 / W;
+  const size_t TSZ = (size_t)1 << W;
+  // table[j][d] = (d << (W*j)) * g ; entry 0 = infinity
+  std::vector<G1> table((size_t)NW * TSZ);
+  G1 wbase = base;                    // g * 2^(W*j)
+  for (int j = 0; j < NW; ++j) {
+    G1* row = table.data() + (size_t)j * TSZ;
+    g1_set_inf(row[0]);
+    row[1] = wbase;
+    for (size_t d = 2; d < TSZ; ++d) g1_add(row[d], row[d - 1], wbase);
+    if (j + 1 < NW) {
+      wbase = row[TSZ - 1];
+      g1_add(wbase, wbase, row[1]);   // g * 2^(W*(j+1))
+    }
+  }
+
+  // scalar powers tau^i in Montgomery Fr, emitted in standard form
+  Fp tau_m;
+  std::memcpy(tau_m.v, tau, 32);
+  to_mont(tau_m, tau_m, Cr);
+  Fp cur_s = Cr.one;                  // tau^0 (Montgomery)
   std::vector<G1> jac(n);
   for (size_t i = 0; i < n; ++i) {
-    jac[i] = cur;
-    if (i + 1 < n) {
-      // cur = tau * cur
-      G1 acc;
-      g1_set_inf(acc);
-      G1 base = cur;
-      for (int limb = 0; limb < 4; ++limb) {
-        u64 bits = tau[limb];
-        for (int b = 0; b < 64; ++b) {
-          if (bits & 1) g1_add(acc, acc, base);
-          g1_dbl(base, base);
-          bits >>= 1;
-        }
-      }
-      cur = acc;
+    Fp s;
+    from_mont(s, cur_s, Cr);          // standard-form scalar
+    G1 acc;
+    g1_set_inf(acc);
+    for (int j = 0; j < NW; ++j) {
+      u64 d = (s.v[(j * W) / 64] >> ((j * W) % 64)) & (TSZ - 1);
+      if (d) g1_add(acc, acc, table[(size_t)j * TSZ + d]);
     }
+    jac[i] = acc;
+    fp_mul(cur_s, cur_s, tau_m, Cr);
   }
   // batch-normalize to affine: montgomery batch inversion of z, skipping
   // infinity points (z == 0 would otherwise poison the whole product)

@@ -1,0 +1,194 @@
+"""Vectorized 384-bit Montgomery arithmetic: BLS12-381 Fq on device (N5).
+
+Closes the `field_ops.py` deferral ("BLS12-381 device field uses 24 limbs;
+later round"): the same 16-bit-limb CIOS design as `field_ops`, widened to
+24 limbs / R = 2^384. Primary witness-side consumer: batched G1 pubkey
+decompression (512 keys per committee, `preprocessor` + fixture generation —
+reference does these on the host with `halo2curves`, SURVEY.md §2b N5).
+
+sqrt uses the p ≡ 3 (mod 4) exponentiation (BLS12-381's base field
+qualifies), so decompression is two batched pows (sqrt + legendre folded
+into one: y = a^((p+1)/4), valid iff y^2 == a).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NLIMBS = 24
+MASK = np.uint32(0xFFFF)
+
+
+class Field384Ctx:
+    def __init__(self, p: int, name: str):
+        assert p.bit_length() <= 384 and p % 4 == 3
+        self.p = p
+        self.name = name
+        self.p_limbs = _int_to_limbs(p)
+        self.n0inv16 = np.uint32((-pow(p, -1, 1 << 16)) % (1 << 16))
+        r = (1 << (16 * NLIMBS)) % p
+        self.r_mod_p = r
+        self.r2 = _int_to_limbs((r * r) % p)
+        self.one_mont = _int_to_limbs(r)
+
+    def encode_np(self, vals) -> np.ndarray:
+        r = self.r_mod_p
+        return _ints_to_limbs([(int(v) % self.p) * r % self.p for v in vals])
+
+    def decode(self, arr) -> list[int]:
+        rinv = pow(self.r_mod_p, -1, self.p)
+        return [v * rinv % self.p for v in _limbs_to_ints(np.asarray(arr))]
+
+
+def _int_to_limbs(v: int) -> np.ndarray:
+    return np.array([(v >> (16 * i)) & 0xFFFF for i in range(NLIMBS)],
+                    dtype=np.uint32)
+
+
+def _ints_to_limbs(vals) -> np.ndarray:
+    out = np.empty((len(vals), NLIMBS), dtype=np.uint32)
+    for i, v in enumerate(vals):
+        out[i] = _int_to_limbs(int(v))
+    return out
+
+
+def _limbs_to_ints(arr: np.ndarray) -> list[int]:
+    arr = arr.reshape(-1, NLIMBS)
+    return [sum(int(row[i]) << (16 * i) for i in range(NLIMBS)) for row in arr]
+
+
+@functools.cache
+def bls_fq_ctx() -> Field384Ctx:
+    from ..fields import bls12_381 as bls
+    return Field384Ctx(bls.P, "bls12_381_fq")
+
+
+def _carry_propagate(t):
+    tT = jnp.moveaxis(t, -1, 0)
+
+    def step(carry, ti):
+        cur = ti + carry
+        return cur >> 16, cur & MASK
+
+    carry, outs = jax.lax.scan(step, jnp.zeros_like(tT[0]), tT)
+    return jnp.moveaxis(outs, 0, -1), carry
+
+
+def _sub_limbs(a, b):
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    aT = jnp.moveaxis(jnp.broadcast_to(a, shape), -1, 0)
+    bT = jnp.moveaxis(jnp.broadcast_to(b, shape), -1, 0)
+
+    def step(borrow, ab):
+        ai, bi = ab
+        cur = ai - bi - borrow
+        return (cur >> 16) & np.uint32(1), cur & MASK
+
+    borrow, outs = jax.lax.scan(step, jnp.zeros_like(aT[0]), (aT, bT))
+    return jnp.moveaxis(outs, 0, -1), borrow
+
+
+def _cond_sub_p(ctx, a):
+    diff, borrow = _sub_limbs(a, jnp.broadcast_to(ctx.p_limbs, a.shape))
+    return jnp.where((borrow == 0)[..., None], diff, a)
+
+
+def add(ctx, a, b):
+    t, _ = _carry_propagate(a + b)
+    return _cond_sub_p(ctx, t)
+
+
+def mont_mul(ctx, a, b):
+    """24-round CIOS; accumulators stay < 2^24 (same magnitude argument as
+    field_ops.mont_mul, two extra limbs of headroom)."""
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape)
+    bT = jnp.moveaxis(jnp.broadcast_to(b, shape), -1, 0)
+    p_limbs = ctx.p_limbs
+    n0 = ctx.n0inv16
+    z1 = jnp.zeros(shape[:-1] + (1,), dtype=jnp.uint32)
+
+    def rnd(t, bi):
+        prod = a * bi[..., None]
+        t = (t
+             + jnp.concatenate([prod & MASK, z1], axis=-1)
+             + jnp.concatenate([z1, prod >> 16], axis=-1))
+        m = (t[..., 0] * n0) & MASK
+        q = p_limbs * m[..., None]
+        t = (t
+             + jnp.concatenate([q & MASK, z1], axis=-1)
+             + jnp.concatenate([z1, q >> 16], axis=-1))
+        carry = t[..., 0:1] >> 16
+        t = jnp.concatenate([t[..., 1:2] + carry, t[..., 2:], z1], axis=-1)
+        return t, None
+
+    t0 = jnp.zeros(shape[:-1] + (NLIMBS + 1,), dtype=jnp.uint32)
+    t, _ = jax.lax.scan(rnd, t0, bT)
+    res, _top = _carry_propagate(t[..., :NLIMBS])
+    return _cond_sub_p(ctx, res)
+
+
+def mont_pow(ctx, a, e: int):
+    """a^e via a fori_loop over the exponent bits (384-bit exponents)."""
+    nbits = e.bit_length()
+    bits = jnp.asarray([(e >> i) & 1 for i in range(nbits)], dtype=jnp.uint32)
+
+    def body(i, carry):
+        result, base = carry
+        mult = mont_mul(ctx, result, base)
+        result = jnp.where((bits[i] == 1)[..., None], mult, result)
+        return (result, mont_mul(ctx, base, base))
+
+    result0 = jnp.broadcast_to(jnp.asarray(ctx.one_mont), a.shape)
+    result, _ = jax.lax.fori_loop(0, nbits, body, (result0, a))
+    return result
+
+
+@functools.cache
+def _decompress_fn():
+    """jitted: x (Montgomery [n,24]) -> (y_mont, ok) with y = sqrt(x^3+4)."""
+    ctx = bls_fq_ctx()
+
+    def fn(xm):
+        b4 = jnp.broadcast_to(jnp.asarray(
+            ctx.encode_np([4])[0]), xm.shape)
+        x3 = mont_mul(ctx, mont_mul(ctx, xm, xm), xm)
+        rhs = add(ctx, x3, b4)
+        y = mont_pow(ctx, rhs, (ctx.p + 1) // 4)
+        ok = jnp.all(mont_mul(ctx, y, y) == rhs, axis=-1)
+        return y, ok
+
+    return jax.jit(fn)
+
+
+def g1_decompress_batch(compressed: list[bytes]) -> list[tuple[int, int]]:
+    """Batched BLS12-381 G1 decompression on device (the 512-pubkey
+    witness-side op). Bit-identical to `bls12_381.g1_decompress` per key —
+    pinned by tests; flags/canonicality are validated on host, the sqrt
+    rides the device."""
+    from ..fields import bls12_381 as bls
+
+    ctx = bls_fq_ctx()
+    xs, signs = [], []
+    for b in compressed:
+        assert len(b) == 48 and b[0] & 0x80, "bad compressed G1"
+        assert not b[0] & 0x40, "infinity not expected in committee keys"
+        xi = int.from_bytes(bytes([b[0] & 0x1F]) + b[1:], "big")
+        assert xi < ctx.p, "x not canonical"
+        xs.append(xi)
+        signs.append(bool(b[0] & 0x20))
+    xm = jnp.asarray(ctx.encode_np(xs))
+    y_m, ok = _decompress_fn()(xm)
+    assert bool(jnp.all(ok)), "point not on curve"
+    ys = ctx.decode(np.asarray(y_m))
+    out = []
+    for xi, y, sgn in zip(xs, ys, signs):
+        # sign normalization matches bls12_381._fq_sign (y > (p-1)/2)
+        if (y > (ctx.p - 1) // 2) != sgn:
+            y = ctx.p - y
+        out.append((xi, y))
+    return out

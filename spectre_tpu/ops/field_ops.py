@@ -175,6 +175,26 @@ def mont_mul(ctx: FieldCtx, a, b):
     return _cond_sub_p(ctx, res)
 
 
+_mont_mul_cios = mont_mul
+
+
+def enable_mxu(on: bool = True):
+    """Swap `mont_mul` for the MXU int8-limb matmul formulation
+    (`field_mxu.mont_mul`, SURVEY.md §7 hard part 2). Call BEFORE the first
+    jit trace of any consumer — already-compiled executables keep whichever
+    implementation they traced. Auto-enabled when SPECTRE_FIELD_IMPL=mxu."""
+    global mont_mul
+    if on:
+        from . import field_mxu
+        mont_mul = field_mxu.mont_mul
+    else:
+        mont_mul = _mont_mul_cios
+
+
+if __import__("os").environ.get("SPECTRE_FIELD_IMPL") == "mxu":
+    enable_mxu()
+
+
 def mont_sqr(ctx: FieldCtx, a):
     return mont_mul(ctx, a, a)
 

@@ -1,0 +1,97 @@
+"""NTT sharded over a device mesh (four-step/Bailey decomposition).
+
+SURVEY.md §2c/§5: the reference's FFT is rayon shared-memory; the TPU-native
+equivalent shards one large NTT across chips with the transpose riding ICI as
+an all-to-all — the classic distributed-FFT structure:
+
+    view x as A[jr, jc] = x[jc*Rr + jr]            (Rr x Cc matrix, Rr*Cc = n)
+    1. per-row NTT of length Cc with root omega^Rr     (local: rows sharded)
+    2. elementwise twiddle A[jr, kc] *= omega^(jr*kc)  (local)
+    3. transpose                                        (all_to_all over ICI)
+    4. per-row NTT of length Rr with root omega^Cc     (local)
+
+and X[kr*Cc + kc] lands at out[kc, kr] — `sharded_ntt` returns the flat
+natural-order result. Identity with the single-device kernel is pinned by
+`tests/test_parallel.py::TestShardedNTT` on the virtual 8-device mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..fields import bn254
+from ..ops import field_ops as F, ntt as NTT
+
+R = bn254.R
+
+
+@functools.cache
+def _twiddle_matrix(logr: int, logc: int, omega: int) -> np.ndarray:
+    """Montgomery [Rr, Cc, 16] table of omega^(jr*kc). Host-computed and
+    cached per (shape, omega) — the prover reuses one omega per domain, so
+    this is a one-time cost per circuit size (device-side generation is the
+    scale-up path once SRS-sized tables stop fitting host memory)."""
+    from ..native import host
+
+    rr, cc = 1 << logr, 1 << logc
+    ctx = F.fr_ctx()
+    rows = np.empty((rr, cc, 16), dtype=np.uint32)
+    for jr in range(rr):
+        w = pow(omega, jr, R)
+        rows[jr] = ctx.encode_np(
+            host.limbs_to_ints(host.fp_powers(host.FR, w, cc)))
+    return rows
+
+
+def sharded_ntt(a: jax.Array, omega: int, mesh: Mesh,
+                axis: str = "data") -> jax.Array:
+    """Distributed NTT of a [n, 16] Montgomery limb tensor; returns the same
+    natural-order [n, 16] result as `ops.ntt.ntt(a, omega)`.
+
+    n must split as Rr*Cc with the shard count dividing both Rr and Cc."""
+    n = a.shape[0]
+    logn = n.bit_length() - 1
+    assert 1 << logn == n, "n must be a power of two"
+    s = mesh.shape[axis]
+    logr = logn // 2
+    logc = logn - logr
+    rr, cc = 1 << logr, 1 << logc
+    assert rr % s == 0 and cc % s == 0, \
+        f"shard count {s} must divide both matrix dims {rr}x{cc}"
+
+    omega_row = pow(omega, rr, R)        # length-Cc root (step 1)
+    omega_col = pow(omega, cc, R)        # length-Rr root (step 4)
+    tw = _twiddle_matrix(logr, logc, omega)
+    ctx = F.fr_ctx()
+
+    # A[jr, jc] = x[jc*rr + jr]
+    A = a.reshape(cc, rr, 16).transpose(1, 0, 2)
+    spec = P(*( [axis] + [None] * 2 ))
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+        check_vma=False)
+    def run(block, twb):
+        # step 1: length-Cc NTT along the local row axis
+        y = jax.vmap(lambda row: NTT.ntt(row, omega_row))(block)
+        # step 2: twiddle
+        y = F.mont_mul(ctx, y, twb)
+        # step 3: transpose via all-to-all (split columns, gather rows)
+        y = jax.lax.all_to_all(y, axis, split_axis=1, concat_axis=0,
+                               tiled=True)              # [rr, cc/s, 16]
+        y = y.transpose(1, 0, 2)                        # [cc/s, rr, 16]
+        # step 4: length-Rr NTT per (now-local) column of the original
+        return jax.vmap(lambda row: NTT.ntt(row, omega_col))(y)
+
+    sharding = NamedSharding(mesh, spec)
+    Ad = jax.device_put(A, sharding)
+    twd = jax.device_put(jnp.asarray(tw), sharding)
+    out = jax.jit(run)(Ad, twd)                          # [cc, rr, 16]
+    # out[kc, kr] = X[kr*cc + kc]
+    return out.transpose(1, 0, 2).reshape(n, 16)

@@ -130,6 +130,9 @@ class TpuBackend(CpuBackend):
         # per-shape compiles dominate small-circuit wall-clock; persist them
         setup_compile_cache()
         self._base_cache: dict = {}   # (id, n) -> device [n,3,16] points
+        import os
+        self._shard_min_logn = int(os.environ.get(
+            "SPECTRE_SHARD_MSM_MIN_LOGN", str(self.SHARD_MSM_MIN_LOGN)))
 
     def _encode_points(self, points):
         import jax
@@ -170,17 +173,51 @@ class TpuBackend(CpuBackend):
         self._base_cache[key] = (points, pts)
         return pts
 
+    # single MSMs at least this large route through the mesh-sharded
+    # kernel when >1 device is attached (SURVEY §2c(a): TP axis; override
+    # via SPECTRE_SHARD_MSM_MIN_LOGN)
+    SHARD_MSM_MIN_LOGN = 20
+
     def msm(self, points, scalars):
+        import jax
         import jax.numpy as jnp
 
         from ..ops import ec, limbs as L16, msm as MSM
 
         m = min(points.shape[0], scalars.shape[0])
+        if jax.local_device_count() > 1 and m >= (1 << self._shard_min_logn):
+            return self._msm_sharded(points, scalars, m)
         pts = self._base_points(points, m)
         sc16 = jnp.asarray(L16.u64limbs_to_u16limbs(scalars[:m]))
         res = MSM.msm(pts, sc16)
         out = ec.decode_points(res[None])[0]
         return out
+
+    def _msm_sharded(self, points, scalars, m: int):
+        """One MSM sharded over the ("data", "win") mesh. Points are padded
+        with infinity (zero scalars) so the data axis divides evenly."""
+        import jax.numpy as jnp
+
+        from ..ops import ec, limbs as L16
+        from ..parallel.mesh import default_mesh
+        from ..parallel.sharded_msm import shard_points, sharded_msm
+
+        mesh = default_mesh()
+        ndata = mesh.shape["data"]
+        mp = ((m + ndata - 1) // ndata) * ndata
+        pts = self._base_points(points, m)
+        if mp > m:
+            from ..ops import field_ops as Fo
+            inf = jnp.zeros((mp - m, 3, 16), dtype=jnp.uint32)
+            # RCB identity (0:1:0), y in Montgomery form
+            inf = inf.at[:, 1].set(jnp.asarray(Fo.fq_ctx().one_mont))
+            pts = jnp.concatenate([pts, inf], axis=0)
+        sc = np.zeros((mp, 16), dtype=np.uint32)
+        sc[:m] = np.asarray(L16.u64limbs_to_u16limbs(scalars[:m]))
+        pd, sd = shard_points(pts, jnp.asarray(sc), mesh)
+        c = 13 if mp >= (1 << 18) else 10
+        res = sharded_msm(pd, sd, c, mesh)
+        return ec.decode_points(np.asarray(res)[None])[0]
 
     def msm_many(self, points, scalars_list):
         """Commit several scalar vectors against one cached device base.

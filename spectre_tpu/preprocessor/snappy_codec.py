@@ -71,10 +71,13 @@ def decompress(data: bytes) -> bytes:
                 off = int.from_bytes(data[pos:pos + 4], "little")
                 pos += 4
             assert 0 < off <= len(out), "snappy copy offset out of range"
-            # overlapping copies are legal (byte-at-a-time semantics)
             start = len(out) - off
-            for i in range(ln):
-                out.append(out[start + i])
+            if off >= ln:                  # non-overlapping: slice copy
+                out += out[start:start + ln]
+            else:
+                # overlapping copies are legal (byte-at-a-time semantics)
+                for i in range(ln):
+                    out.append(out[start + i])
     assert len(out) == expected, \
         f"snappy length mismatch: {len(out)} != {expected}"
     return bytes(out)
@@ -92,12 +95,9 @@ def compress(data: bytes) -> bytes:
         elif ln < (1 << 8):
             out.append(60 << 2)
             out += ln.to_bytes(1, "little")
-        elif ln < (1 << 16):
+        else:                       # chunks are capped at 65536: ln < 2^16
             out.append(61 << 2)
             out += ln.to_bytes(2, "little")
-        else:
-            out.append(62 << 2)
-            out += ln.to_bytes(3, "little")
         out += chunk
         pos += len(chunk)
     return bytes(out)

@@ -38,6 +38,13 @@ CAPELLA_FORK_VERSION = {
 }
 
 
+def _fork_version(spec) -> bytes:
+    assert spec.name in CAPELLA_FORK_VERSION, \
+        f"no Capella fork version registered for spec {spec.name!r} — " \
+        f"signing-domain computation would be wrong"
+    return CAPELLA_FORK_VERSION[spec.name]
+
+
 def load_snappy_ssz(path: str, ssz_type: ssz.SSZType):
     with open(path, "rb") as f:
         return ssz_type.decode(snappy_codec.decompress(f.read()))
@@ -86,14 +93,11 @@ def to_sync_circuit_witness(spec, bootstrap_committee: ssz.Obj, update: ssz.Obj,
     """`to_sync_ciruit_witness` (`test-utils/src/lib.rs:133-244`)."""
     exec_type = ssz.execution_payload_header(
         spec.bytes_per_logs_bloom, spec.max_extra_data_bytes)
-    pubkeys = []
-    for pk in bootstrap_committee.pubkeys:
-        x, y = bls.g1_decompress(pk)
-        pubkeys.append((int(x), int(y)))
+    from ..ops.field384 import g1_decompress_batch
+    pubkeys = g1_decompress_batch(list(bootstrap_committee.pubkeys))
     domain = ssz.compute_domain(
         ssz.DOMAIN_SYNC_COMMITTEE,
-        CAPELLA_FORK_VERSION.get(spec.name, CAPELLA_FORK_VERSION["minimal"]),
-        genesis_validators_root)
+        _fork_version(spec), genesis_validators_root)
     return SyncStepArgs(
         signature_compressed=update.sync_aggregate.sync_committee_signature,
         pubkeys_uncompressed=pubkeys,
@@ -145,8 +149,9 @@ def get_initial_sync_committee_poseidon(test_dir: str, spec) -> tuple[int, int]:
     bootstrap = load_snappy_ssz(
         os.path.join(test_dir, "bootstrap.ssz_snappy"),
         ssz.light_client_bootstrap(spec))
-    pts = [bls.g1_decompress(pk)
-           for pk in bootstrap.current_sync_committee.pubkeys]
+    from ..ops.field384 import g1_decompress_batch
+    pts = [(bls.Fq(x), bls.Fq(y)) for x, y in g1_decompress_batch(
+        list(bootstrap.current_sync_committee.pubkeys))]
     commitment = PC.committee_poseidon_from_uncompressed(pts)
     period = bootstrap.header.beacon.slot // spec.slots_per_period
     return period, commitment
@@ -241,8 +246,9 @@ def generate_spec_test(test_dir: str, spec, seed: int = 7) -> None:
                for i in range(n)]
 
     def committee_obj(pks):
+        from ..ops.field384 import g1_decompress_batch
         agg = bls.aggregate_pubkeys(
-            [bls.g1_decompress(pk) for pk in pks])
+            [(bls.Fq(x), bls.Fq(y)) for x, y in g1_decompress_batch(list(pks))])
         return ssz.Obj(pubkeys=list(pks), aggregate_pubkey=bls.g1_compress(agg))
 
     cur_committee = committee_obj(cur_pks)
@@ -301,9 +307,7 @@ def generate_spec_test(test_dir: str, spec, seed: int = 7) -> None:
 
     gvr = _filler(3)
     domain = ssz.compute_domain(
-        ssz.DOMAIN_SYNC_COMMITTEE,
-        CAPELLA_FORK_VERSION.get(spec.name, CAPELLA_FORK_VERSION["minimal"]),
-        gvr)
+        ssz.DOMAIN_SYNC_COMMITTEE, _fork_version(spec), gvr)
     from ..gadgets.ssz_merkle import sha256_pair_native
     signing_root = sha256_pair_native(att_beacon_root, domain)
     msg_point = bls.hash_to_g2(signing_root, spec.dst)
