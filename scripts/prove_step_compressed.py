@@ -162,7 +162,6 @@ def main():
 
     oproof_path = os.path.join(
         BUILD_DIR, f"agg_step_{spec.name}_{k_agg}_keccak.proof")
-    stmt = AggregationCircuit.get_instances(agg_args, spec)
     if os.path.exists(oproof_path):
         with open(oproof_path, "rb") as f:
             oproof = f.read()
@@ -170,6 +169,7 @@ def main():
             stmt = [int(v, 16) for v in json.load(f)["instances"]]
         log(f"stage-2 proof loaded from cache ({len(oproof)} bytes)")
     else:
+        stmt = AggregationCircuit.get_instances(agg_args, spec)
         t = time.time()
         oproof = agg_cls.prove(agg_pk, srs_agg, agg_args, spec,
                                transcript=KeccakTranscript())

@@ -241,7 +241,19 @@ class Context:
     def layout(self, cfg: CircuitConfig):
         """Place units into columns. Returns (advice_cols, lookup_cols,
         fixed_cols, selectors, copies, instances) for plonk.Assignment —
-        and the break points (row where each column's stream segment ends)."""
+        and the break points (row where each column's stream segment ends).
+
+        Memoized on the config: `create_pk` runs layout once for the pinning
+        (break points) and once for the assignment — at 30M cells each pass
+        is minutes of pure Python, so the second is a cache hit."""
+        cached = getattr(self, "_layout_cache", None)
+        if cached is not None and cached[0] == cfg:
+            return cached[1]
+        result = self._layout_uncached(cfg)
+        self._layout_cache = (cfg, result)
+        return result
+
+    def _layout_uncached(self, cfg: CircuitConfig):
         n, u = cfg.n, cfg.usable_rows
         advice = [[0] * n for _ in range(cfg.num_advice)]
         selectors = [[0] * n for _ in range(cfg.num_advice)]

@@ -60,7 +60,8 @@ def step_args_from_finality_update(update: dict, pubkeys_compressed: list,
     bits = _participation_bits(update["sync_aggregate"]["sync_committee_bits"],
                                spec.sync_committee_size)
     from ..ops.field384 import g1_decompress_batch
-    pubkeys = g1_decompress_batch([_bytes(pk) for pk in pubkeys_compressed])
+    pubkeys = [(bls.Fq(x), bls.Fq(y)) for x, y in
+               g1_decompress_batch([_bytes(pk) for pk in pubkeys_compressed])]
     assert len(pubkeys) == spec.sync_committee_size
 
     args = SyncStepArgs(
