@@ -18,24 +18,40 @@ R = bn254.R
 
 
 def _host_fingerprint() -> str:
-    """4-byte tag of this host's CPU feature flags. AOT entries compiled on
-    a machine with different features ABORT (SIGILL class) when loaded by
-    XLA:CPU — observed as `Fatal Python error: Aborted` inside _cache_read
-    when /tmp survived a host migration. Keying the cache dir by features
-    makes foreign entries unreachable instead of fatal."""
+    """4-byte tag of this host's CPU feature flags + CPU MODEL + jaxlib
+    version. AOT entries compiled on a machine with different features
+    ABORT (SIGILL class) when loaded by XLA:CPU — observed as `Fatal Python
+    error: Aborted` inside _cache_read when /tmp survived a host migration.
+    Flags alone are not enough: XLA also tunes codegen by model
+    (+prefer-no-scatter/gather), so same-flags/different-model hosts make
+    every entry stale and force per-kernel recompiles (observed: commit
+    phase 9min -> 2h). Keying the cache dir by all three makes foreign
+    entries unreachable instead of fatal/slow."""
     import hashlib
     import platform
-    feat = ""
+    feat = model = ""
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
                 # x86 "flags", aarch64 "Features"
-                if line.startswith(("flags", "Features")):
+                if not feat and line.startswith(("flags", "Features")):
                     feat = line.strip()
+                # XLA tunes codegen by CPU MODEL too (+prefer-no-scatter/
+                # gather etc.): hosts with identical flag sets but different
+                # models produce mutually-stale AOT entries (observed: every
+                # kernel recompiled after a migration, commit phase 9min->2h)
+                if not model and line.startswith("model name"):
+                    model = line.strip()
+                if feat and model:
                     break
     except OSError:
         pass
-    ident = f"{platform.machine()}|{feat}"
+    try:
+        import jaxlib
+        jl = getattr(jaxlib, "__version__", "")
+    except Exception:
+        jl = ""
+    ident = f"{platform.machine()}|{model}|{feat}|jaxlib-{jl}"
     return hashlib.blake2s(ident.encode(), digest_size=4).hexdigest()
 
 
