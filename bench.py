@@ -135,7 +135,7 @@ def device_phase(out_path: str):
             print(f"# bench impl {impl_name} failed: {infra_fail}",
                   file=sys.stderr, flush=True)
             continue
-        if F.mont_mul is not F._mont_mul_cios:
+        if F._USE_MXU:
             impl_name += "+mxu"    # SPECTRE_FIELD_IMPL=mxu matmul field path
         with open(out_path, "w") as f:
             json.dump({"points_per_s": n / dt, "impl": impl_name,

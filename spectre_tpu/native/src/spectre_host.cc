@@ -656,9 +656,12 @@ void g1_scalar_powers(const u64* g_xy, const u64* tau, size_t n, u64* out) {
   base.z = C.one;
 
   // Window width from n (W must divide 64 so digits never straddle limbs).
-  // Total adds ~ (256/W) * (2^W + n): the break-evens are n=224 (4->8) and
-  // n=65024 (8->16) — small setups must not pay a 1M-add precompute.
-  const int W = n <= 224 ? 4 : n <= 65024 ? 8 : 16;
+  // Total adds ~ (256/W) * (2^W + n): the pure-add break-evens are n=224
+  // (4->8) and n=65024 (8->16), but W=16 also means a 16x65536-entry table
+  // (~100 MB) and ~1M precompute adds before any output — on a small-RAM
+  // host that spike only pays off for multi-million-point SRS sizes, so the
+  // 8->16 switch is held back to n >= 2^20.
+  const int W = n <= 224 ? 4 : n < (1u << 20) ? 8 : 16;
   const int NW = 256 / W;
   const size_t TSZ = (size_t)1 << W;
   // table[j][d] = (d << (W*j)) * g ; entry 0 = infinity

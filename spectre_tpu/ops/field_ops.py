@@ -74,12 +74,6 @@ def fq_ctx() -> FieldCtx:
     return FieldCtx(bn254.P, "bn254_fq")
 
 
-@functools.cache
-def bls_fq_ctx() -> FieldCtx:
-    """BLS12-381 Fq needs 24 limbs; kept for witness-side batched ops later."""
-    raise NotImplementedError("BLS12-381 device field uses 24 limbs; later round")
-
-
 # ---------------------------------------------------------------------------
 # core arithmetic (all shapes [..., 16] uint32)
 # ---------------------------------------------------------------------------
@@ -139,7 +133,7 @@ def neg(ctx: FieldCtx, a):
     return jnp.where(is_zero, jnp.zeros_like(a), _cond_sub_p(ctx, pb))
 
 
-def mont_mul(ctx: FieldCtx, a, b):
+def _mont_mul_cios(ctx: FieldCtx, a, b):
     """Montgomery product a*b*R^{-1} mod p: 16 CIOS rounds as a lax.scan.
 
     Each round is a fully vectorized multiply-accumulate over the batch; the
@@ -175,20 +169,27 @@ def mont_mul(ctx: FieldCtx, a, b):
     return _cond_sub_p(ctx, res)
 
 
-_mont_mul_cios = mont_mul
+_USE_MXU = False
+
+
+def mont_mul(ctx: FieldCtx, a, b):
+    """Montgomery product dispatcher: CIOS scan by default, the MXU int8-limb
+    matmul formulation (`field_mxu.mont_mul`, SURVEY.md §7 hard part 2) when
+    `enable_mxu()` has been called. The flag is read at TRACE time, so a
+    `from field_ops import mont_mul` binding still follows later swaps;
+    executables compiled before the swap keep the implementation they traced
+    (re-jit to pick up the new one)."""
+    if _USE_MXU:
+        from . import field_mxu
+        return field_mxu.mont_mul(ctx, a, b)
+    return _mont_mul_cios(ctx, a, b)
 
 
 def enable_mxu(on: bool = True):
-    """Swap `mont_mul` for the MXU int8-limb matmul formulation
-    (`field_mxu.mont_mul`, SURVEY.md §7 hard part 2). Call BEFORE the first
-    jit trace of any consumer — already-compiled executables keep whichever
-    implementation they traced. Auto-enabled when SPECTRE_FIELD_IMPL=mxu."""
-    global mont_mul
-    if on:
-        from . import field_mxu
-        mont_mul = field_mxu.mont_mul
-    else:
-        mont_mul = _mont_mul_cios
+    """Route `mont_mul` through the MXU formulation (see dispatcher above).
+    Auto-enabled when SPECTRE_FIELD_IMPL=mxu."""
+    global _USE_MXU
+    _USE_MXU = bool(on)
 
 
 if __import__("os").environ.get("SPECTRE_FIELD_IMPL") == "mxu":

@@ -52,25 +52,36 @@ def decompress(data: bytes) -> bytes:
             ln = tag >> 2
             if ln >= 60:
                 nbytes = ln - 59
+                if pos + nbytes > n:
+                    raise ValueError("truncated snappy stream (literal length)")
                 ln = int.from_bytes(data[pos:pos + nbytes], "little")
                 pos += nbytes
             ln += 1
+            if pos + ln > n:
+                raise ValueError("truncated snappy stream (literal body)")
             out += data[pos:pos + ln]
             pos += ln
         else:
             if kind == 1:                  # copy, 1-byte offset
+                if pos + 1 > n:
+                    raise ValueError("truncated snappy stream (copy1 offset)")
                 ln = ((tag >> 2) & 7) + 4
                 off = ((tag >> 5) << 8) | data[pos]
                 pos += 1
             elif kind == 2:                # copy, 2-byte offset
+                if pos + 2 > n:
+                    raise ValueError("truncated snappy stream (copy2 offset)")
                 ln = (tag >> 2) + 1
                 off = int.from_bytes(data[pos:pos + 2], "little")
                 pos += 2
             else:                          # copy, 4-byte offset
+                if pos + 4 > n:
+                    raise ValueError("truncated snappy stream (copy4 offset)")
                 ln = (tag >> 2) + 1
                 off = int.from_bytes(data[pos:pos + 4], "little")
                 pos += 4
-            assert 0 < off <= len(out), "snappy copy offset out of range"
+            if not 0 < off <= len(out):
+                raise ValueError("snappy copy offset out of range")
             start = len(out) - off
             if off >= ln:                  # non-overlapping: slice copy
                 out += out[start:start + ln]
@@ -78,8 +89,8 @@ def decompress(data: bytes) -> bytes:
                 # overlapping copies are legal (byte-at-a-time semantics)
                 for i in range(ln):
                     out.append(out[start + i])
-    assert len(out) == expected, \
-        f"snappy length mismatch: {len(out)} != {expected}"
+    if len(out) != expected:
+        raise ValueError(f"snappy length mismatch: {len(out)} != {expected}")
     return bytes(out)
 
 

@@ -35,6 +35,12 @@ from . import snappy_codec, ssz
 CAPELLA_FORK_VERSION = {
     "minimal": bytes([3, 0, 0, 1]),
     "mainnet": bytes([3, 0, 0, 0]),
+    # The repo-local presets have no official consensus config; these
+    # self-assigned versions only need to be internally consistent (the same
+    # value signs and verifies the self-generated fixtures — distinct from
+    # the official ones so domains can never cross).
+    "testnet": bytes([3, 0, 0, 2]),
+    "tiny": bytes([3, 0, 0, 3]),
 }
 
 

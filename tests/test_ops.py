@@ -258,18 +258,37 @@ class TestMxuField:
             for x, y, z in zip(xs, ys, ctx.decode(got)):
                 assert z == x * y % ctx.p
 
-    def test_enable_mxu_rebinds(self):
+    def test_enable_mxu_dispatch_flag(self):
+        # mont_mul dispatches on the module flag at trace time (no global
+        # rebinding), so stale `from field_ops import mont_mul` bindings
+        # still follow enable_mxu() swaps.
         from spectre_tpu.ops import field_mxu as M
-        before = F.mont_mul
+        before = F._USE_MXU
+        ctx = F.fr_ctx()
+        a, b = ctx.encode([3, 5]), ctx.encode([7, 11])
+        routed = []
+        real = M.mont_mul
+
+        def spy(c, x, y):
+            routed.append(True)
+            return real(c, x, y)
+
+        M.mont_mul = spy
         try:
             F.enable_mxu(True)
-            assert F.mont_mul is M.mont_mul
+            got = ctx.decode(F.mont_mul(ctx, a, b))
+            assert routed, "enable_mxu(True) did not route through field_mxu"
+            assert got == [21, 55]
             F.enable_mxu(False)
-            assert F.mont_mul is F._mont_mul_cios
+            routed.clear()
+            got = ctx.decode(F.mont_mul(ctx, a, b))
+            assert not routed, "enable_mxu(False) still routes through field_mxu"
+            assert got == [21, 55]
         finally:
+            M.mont_mul = real
             # restore whatever the process was configured with (e.g. a
             # suite-wide SPECTRE_FIELD_IMPL=mxu run must stay on mxu)
-            F.mont_mul = before
+            F.enable_mxu(before)
 
 
 class TestGrainSecondSource:
