@@ -68,12 +68,15 @@ class StepCircuit(AppCircuit):
     # uses the lookup ("flex") SHA chip (reference: `Sha256Chip` =
     # sha256_flex, `sync_step_circuit.rs:71`), committee-update keeps the
     # wide region (reference: `Sha256ChipWide`). The ~45k-cells/block cost
-    # of the 66 hashed blocks is bought back by lookup_bits=16 halving
+    # of the 66 hashed blocks is bought back by a big range table halving
     # every range-check in the non-native BLS arithmetic (reference pins
     # lookup_bits=20 at k=21 for the same reason,
-    # `config/sync_step_testnet.json`).
+    # `config/sync_step_testnet.json`). Measured at Testnet-512/k=21:
+    # lookup_bits=16 -> 17 advice / 35.6M cells; 18 -> 16 advice / 32.79M
+    # cells (-8%); every advice column dropped is one fewer commitment in
+    # the inner proof and a smaller in-circuit verifier downstream.
     use_wide_sha = False
-    default_lookup_bits = 16
+    default_lookup_bits = 18
 
     @classmethod
     def build(cls, ctx: Context, args: SyncStepArgs, spec,

@@ -65,6 +65,10 @@ class HostLib:
             lib.fp_sum.restype = None
             lib.fp_scale_batch.argtypes = [ctypes.c_int, u64p, u64p, u64p, ctypes.c_size_t]
             lib.fp_scale_batch.restype = None
+            lib.fp_add_scalar_batch.argtypes = [ctypes.c_int, u64p, u64p, u64p, ctypes.c_size_t]
+            lib.fp_add_scalar_batch.restype = None
+            lib.fp_axpy_batch.argtypes = [ctypes.c_int, u64p, u64p, u64p, u64p, ctypes.c_size_t]
+            lib.fp_axpy_batch.restype = None
             lib.fp_powers.argtypes = [ctypes.c_int, u64p, u64p, ctypes.c_size_t]
             lib.fp_powers.restype = None
             lib.fp_prefix_prod.argtypes = [ctypes.c_int, u64p, u64p, ctypes.c_size_t]
@@ -219,6 +223,28 @@ def fp_scale_batch(field: int, a: np.ndarray, s: int) -> np.ndarray:
     sl = ints_to_limbs([s]).reshape(4)
     out = np.empty_like(a)
     lib.fp_scale_batch(field, _u64p(a), _u64p(sl), _u64p(out), a.shape[0])
+    return out
+
+
+def fp_add_scalar_batch(field: int, a: np.ndarray, s: int) -> np.ndarray:
+    lib = HostLib().lib
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    assert a.ndim == 2 and a.shape[1] == 4
+    sl = ints_to_limbs([s]).reshape(4)
+    out = np.empty_like(a)
+    lib.fp_add_scalar_batch(field, _u64p(a), _u64p(sl), _u64p(out), a.shape[0])
+    return out
+
+
+def fp_axpy_batch(field: int, a: np.ndarray, s: int, b: np.ndarray) -> np.ndarray:
+    """out = a*s + b elementwise (one pass)."""
+    lib = HostLib().lib
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    b = np.ascontiguousarray(b, dtype=np.uint64)
+    assert a.shape == b.shape and a.ndim == 2 and a.shape[1] == 4
+    sl = ints_to_limbs([s]).reshape(4)
+    out = np.empty_like(a)
+    lib.fp_axpy_batch(field, _u64p(a), _u64p(sl), _u64p(b), _u64p(out), a.shape[0])
     return out
 
 

@@ -12,8 +12,12 @@
 // uint64 limbs in standard (non-Montgomery) form at the boundary; points are
 // affine (x, y) limb pairs, infinity flagged separately.
 
+#include <array>
 #include <cstdint>
 #include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -431,6 +435,52 @@ void fp_pow_single(int field, const u64* a, const u64* e, u64* out) {
 // ---- NTT over Fr (in place, standard form at the boundary) ----
 // omega must be a primitive 2^logn-th root of unity.
 
+// Twiddle plan: all stage twiddles in Montgomery form, stage with half-width
+// h occupying entries [h-1, 2h-1) — total n-1 entries. A prove runs ~90
+// same-(omega, size) NTTs over the extended domain (one per committed
+// column, `prover.py::_quotient_host`), so the table is built once (~n muls)
+// and every butterfly thereafter costs ONE mul instead of two (the serial
+// `w *= wm` chain per block is gone). Same arithmetic, bit-identical output.
+struct NttPlan {
+  std::vector<Fp> tw;
+};
+
+std::mutex g_ntt_plan_mu;
+std::map<std::array<u64, 5>, std::shared_ptr<NttPlan>> g_ntt_plans;
+
+std::shared_ptr<NttPlan> ntt_plan(size_t logn, const Fp& omega_mont,
+                                  const FpCtx& C) {
+  std::array<u64, 5> key{omega_mont.v[0], omega_mont.v[1], omega_mont.v[2],
+                         omega_mont.v[3], (u64)logn};
+  {
+    std::lock_guard<std::mutex> g(g_ntt_plan_mu);
+    auto it = g_ntt_plans.find(key);
+    if (it != g_ntt_plans.end()) return it->second;
+  }
+  const size_t n = (size_t)1 << logn;
+  auto plan = std::make_shared<NttPlan>();
+  plan->tw.resize(n - 1);
+  for (size_t m = 2; m <= n; m <<= 1) {
+    const size_t h = m >> 1;
+    Fp wm = omega_mont;
+    for (size_t k = m; k < n; k <<= 1) fp_sqr(wm, wm, C);  // omega^(n/m)
+    Fp w = C.one;
+    Fp* row = plan->tw.data() + (h - 1);
+    for (size_t j = 0; j < h; ++j) {
+      row[j] = w;
+      fp_mul(w, w, wm, C);
+    }
+  }
+  std::lock_guard<std::mutex> g(g_ntt_plan_mu);
+  // the prover uses 4 (omega, size) pairs per circuit degree (fwd/inv x
+  // base/extended); bound the cache, but evict ONE entry — clear() would
+  // wipe the hot set whenever a service rotates through 3+ degrees and
+  // re-pay the plan build ~90x per prove
+  if (g_ntt_plans.size() > 12) g_ntt_plans.erase(g_ntt_plans.begin());
+  g_ntt_plans[key] = plan;
+  return plan;
+}
+
 void fr_ntt(u64* data, size_t logn, const u64* omega_std) {
   spectre_init();
   const FpCtx& C = g_fr;
@@ -451,19 +501,20 @@ void fr_ntt(u64* data, size_t logn, const u64* omega_std) {
   Fp omega;
   std::memcpy(omega.v, omega_std, 32);
   to_mont(omega, omega, C);
-  // stage twiddles: w_m = omega^(n/m)
+  auto plan = ntt_plan(logn, omega, C);
+  const Fp* tw = plan->tw.data();
   for (size_t m = 2; m <= n; m <<= 1) {
-    Fp wm = omega;
-    for (size_t k = m; k < n; k <<= 1) fp_sqr(wm, wm, C);  // omega^(n/m)
+    const size_t h = m >> 1;
+    const Fp* wrow = tw + (h - 1);
     for (size_t start = 0; start < n; start += m) {
-      Fp w = C.one;
-      for (size_t j = 0; j < m / 2; ++j) {
+      Fp* lo = a.data() + start;
+      Fp* hi = lo + h;
+      for (size_t j = 0; j < h; ++j) {
         Fp t, u;
-        fp_mul(t, a[start + j + m / 2], w, C);
-        u = a[start + j];
-        fp_add(a[start + j], u, t, C);
-        fp_sub(a[start + j + m / 2], u, t, C);
-        fp_mul(w, w, wm, C);
+        fp_mul(t, hi[j], wrow[j], C);
+        u = lo[j];
+        fp_add(lo[j], u, t, C);
+        fp_sub(hi[j], u, t, C);
       }
     }
   }
@@ -727,6 +778,44 @@ void g1_scalar_powers(const u64* g_xy, const u64* tau, size_t n, u64* out) {
 }
 
 // pointwise ops used by the prover's quotient evaluation (standard form)
+
+// out[i] = a[i] + s mod p. Representation-agnostic (add needs no Montgomery),
+// one pass — replaces building an n-row constant array host-side just to
+// call fp_add_batch (the expression contexts' add_const was doing exactly
+// that, ~2s of Python marshalling per call at the k=21 extended domain).
+void fp_add_scalar_batch(int field, const u64* a, const u64* s /*4 limbs*/,
+                         u64* out, size_t n) {
+  const FpCtx& C = pick(field);
+  Fp sv;
+  std::memcpy(sv.v, s, 32);
+  for (size_t i = 0; i < n; ++i) {
+    Fp am, r;
+    std::memcpy(am.v, a + 4 * i, 32);
+    fp_add(r, am, sv, C);
+    std::memcpy(out + 4 * i, r.v, 32);
+  }
+}
+
+// out[i] = a[i]*s + b[i] mod p: the quotient's y-combination
+// (acc = acc*y + e) as ONE pass instead of scale-then-add two-pass.
+void fp_axpy_batch(int field, const u64* a, const u64* s /*4 limbs*/,
+                   const u64* b, u64* out, size_t n) {
+  const FpCtx& C = pick(field);
+  Fp sm;
+  std::memcpy(sm.v, s, 32);
+  to_mont(sm, sm, C);
+  for (size_t i = 0; i < n; ++i) {
+    Fp am, bm, r;
+    std::memcpy(am.v, a + 4 * i, 32);
+    std::memcpy(bm.v, b + 4 * i, 32);
+    to_mont(am, am, C);
+    fp_mul(r, am, sm, C);
+    from_mont(r, r, C);
+    fp_add(r, r, bm, C);
+    std::memcpy(out + 4 * i, r.v, 32);
+  }
+}
+
 void fp_scale_batch(int field, const u64* a, const u64* s /*4 limbs*/, u64* out, size_t n) {
   const FpCtx& C = pick(field);
   Fp sm;

@@ -78,6 +78,13 @@ def zeros(n: int) -> np.ndarray:
     return np.zeros((n, 4), dtype=np.uint64)
 
 
+def const_arr(s: int, n: int) -> np.ndarray:
+    """[n, 4] array of the constant s — np.tile of one marshalled row
+    (building the same list[int] n times through ints_to_limbs was seconds
+    per call at extended-domain sizes)."""
+    return np.tile(host.ints_to_limbs([int(s) % R]), (n, 1))
+
+
 class CpuBackend:
     """Native C++ single-host backend (the measured baseline)."""
 
@@ -98,6 +105,13 @@ class CpuBackend:
 
     def scale(self, a, s: int):
         return host.fp_scale_batch(host.FR, a, s)
+
+    def add_scalar(self, a, s: int):
+        return host.fp_add_scalar_batch(host.FR, a, s % R)
+
+    def axpy(self, a, s: int, b):
+        """a*s + b elementwise, one pass (quotient y-combination)."""
+        return host.fp_axpy_batch(host.FR, a, s % R, b)
 
     def powers(self, x: int, n: int):
         return host.fp_powers(host.FR, x, n)

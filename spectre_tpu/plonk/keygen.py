@@ -195,6 +195,13 @@ class ProvingKey:
     sha_selector_polys: list = None
     sha_k_poly: object = None
 
+    def release_ext_cache(self):
+        """Drop the prover's cached extended-domain forms of the fixed
+        columns (populated lazily by `_quotient_host`, ~GBs at k=21). A
+        service holding several pks calls this on the idle families so
+        peaks don't stack (`prover_service/state.py`)."""
+        self.__dict__.pop("_ext_fixed_cache", None)
+
 
 def keygen(srs: SRS, cfg: CircuitConfig, fixed_columns: list, selectors: list,
            copies: list, bk=None) -> ProvingKey:

@@ -34,9 +34,9 @@ class _ArrayCtx:
         self._dom = dom
         self._bk = bk
         self._ext = ext_cache
-        # X on the extended coset: g * omega_ext^i
+        # X on the extended coset: g * omega_ext^i (powers domain-cached)
         from .domain import COSET_GEN
-        xs = bk.powers(dom.omega_ext, dom.n_ext)
+        xs = dom._coset_powers(dom.omega_ext, bk)
         self.x_col = bk.scale(xs, COSET_GEN)
         self.l0 = None      # filled by prover
         self.llast = None
@@ -63,10 +63,10 @@ class _ArrayCtx:
         return self._bk.scale(a, s % R)
 
     def add_const(self, a, s):
-        return self._bk.add(a, B.to_arr([s % R] * a.shape[0]))
+        return self._bk.add_scalar(a, s)
 
     def const(self, s):
-        return B.to_arr([s % R] * self._dom.n_ext)
+        return B.const_arr(s, self._dom.n_ext)
 
 
 def lookup_grand_product(bk, n: int, u: int, a_v, pa_v, pt_v, t_v,
@@ -74,10 +74,10 @@ def lookup_grand_product(bk, n: int, u: int, a_v, pa_v, pt_v, t_v,
     """Running product z for one lookup column; telescopes to 1 at row u for
     honest witnesses (asserted — the l_last boundary constraint enforces it
     in-proof)."""
-    num = bk.mul(bk.add(B.to_arr(a_v), B.to_arr([beta] * n)),
-                 bk.add(B.to_arr(t_v), B.to_arr([gamma] * n)))
-    den = bk.mul(bk.add(B.to_arr(pa_v), B.to_arr([beta] * n)),
-                 bk.add(B.to_arr(pt_v), B.to_arr([gamma] * n)))
+    num = bk.mul(bk.add_scalar(B.to_arr(a_v), beta),
+                 bk.add_scalar(B.to_arr(t_v), gamma))
+    den = bk.mul(bk.add_scalar(B.to_arr(pa_v), beta),
+                 bk.add_scalar(B.to_arr(pt_v), gamma))
     ratio = B.arr_to_ints(bk.mul(num, bk.inv(den)))
     for i in range(u, n):
         ratio[i] = 1
@@ -214,11 +214,11 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
         for gidx, key in cols:
             v_arr = B.to_arr(col_values(key))
             dj = pow(DELTA, gidx, R)
-            id_term = bk.add(v_arr, bk.add(bk.scale(omega_pows, beta * dj % R),
-                                           B.to_arr([gamma] * n)))
-            sig_term = bk.add(v_arr, bk.add(
-                bk.scale(B.to_arr(pk.sigma_values[gidx]), beta),
-                B.to_arr([gamma] * n)))
+            id_term = bk.add_scalar(
+                bk.add(v_arr, bk.scale(omega_pows, beta * dj % R)), gamma)
+            sig_term = bk.add_scalar(
+                bk.add(v_arr, bk.scale(B.to_arr(pk.sigma_values[gidx]), beta)),
+                gamma)
             num = bk.mul(num, id_term)
             den = bk.mul(den, sig_term)
         ratio = bk.mul(num, bk.inv(den))
@@ -333,28 +333,51 @@ def _quotient_host(cfg, dom, bk, pk, polys, beta, gamma, y):
     the extended coset (CPU path)."""
     n, u = cfg.n, cfg.usable_rows
     ext_cache: dict = {}
+    # Circuit-FIXED columns (selectors, fixed, sigmas, tables) have the same
+    # extended form every prove; their ~n-per-circuit 4n-NTTs were about half
+    # of quotient wall-clock (BASELINE.md r4: quotient 41-49% of prove).
+    # Cache them on the pk object (in-memory only, never persisted): a
+    # prover service re-proving against one pk pays the NTTs once.
+    _FIXED_KINDS = ("q", "fix", "sig", "tab", "shq", "shk")
+    pk_ext = pk.__dict__.setdefault("_ext_fixed_cache", {})
+    # cap resident bytes per pk (idle-circuit caches stack in a service —
+    # see ProvingKey.release_ext_cache); over budget we compute transiently
+    import os as _os
+    ext_budget = int(_os.environ.get("SPECTRE_EXT_CACHE_MB", "16384")) << 20
+
+    def _within_budget(arr):
+        return (sum(a.nbytes for a in pk_ext.values()) + arr.nbytes
+                <= ext_budget)
 
     def ext(key):
-        if key not in ext_cache:
-            if key in polys:
-                ext_cache[key] = dom.coeff_to_extended(polys[key], bk)
-            elif key[0] == "q":
-                ext_cache[key] = dom.coeff_to_extended(pk.selector_polys[key[1]], bk)
-            elif key[0] == "fix":
-                ext_cache[key] = dom.coeff_to_extended(pk.fixed_polys[key[1]], bk)
-            elif key[0] == "sig":
-                ext_cache[key] = dom.coeff_to_extended(pk.sigma_polys[key[1]], bk)
-            elif key[0] == "tab":
-                ext_cache[key] = dom.coeff_to_extended(pk.table_polys[key[1]], bk)
-            elif key[0] == "shq":
-                ext_cache[key] = dom.coeff_to_extended(
-                    pk.sha_selector_polys[key[1]], bk)
-            elif key[0] == "shk":
-                ext_cache[key] = dom.coeff_to_extended(pk.sha_k_poly, bk)
-            else:
-                # ("inst", j) is pre-populated in polys by prove()
-                raise KeyError(key)
-        return ext_cache[key]
+        if key in ext_cache:
+            return ext_cache[key]
+        if key in polys:
+            ext_cache[key] = dom.coeff_to_extended(polys[key], bk)
+            return ext_cache[key]
+        if key[0] in _FIXED_KINDS:
+            hit = pk_ext.get(key)
+            if hit is None:
+                if key[0] == "q":
+                    coeffs = pk.selector_polys[key[1]]
+                elif key[0] == "fix":
+                    coeffs = pk.fixed_polys[key[1]]
+                elif key[0] == "sig":
+                    coeffs = pk.sigma_polys[key[1]]
+                elif key[0] == "tab":
+                    coeffs = pk.table_polys[key[1]]
+                elif key[0] == "shq":
+                    coeffs = pk.sha_selector_polys[key[1]]
+                else:
+                    coeffs = pk.sha_k_poly
+                hit = dom.coeff_to_extended(coeffs, bk)
+                if _within_budget(hit):
+                    pk_ext[key] = hit
+                else:
+                    ext_cache[key] = hit   # per-prove lifetime only
+            return hit
+        # ("inst", j) is pre-populated in polys by prove()
+        raise KeyError(key)
 
     rot_cache: dict = {}
 
@@ -373,22 +396,28 @@ def _quotient_host(cfg, dom, bk, pk, polys, beta, gamma, y):
             return hit
 
     ctx = LazyCtx(cfg, dom, bk, ext_cache)
-    # l0 / l_last / l_blind on the extended coset
-    l0_vals = [0] * n
-    l0_vals[0] = 1
-    llast_vals = [0] * n
-    llast_vals[cfg.last_row] = 1
-    lblind_vals = [0] * n
-    for i in range(u + 1, n):
-        lblind_vals[i] = 1
-    ctx.l0 = dom.coeff_to_extended(dom.lagrange_to_coeff(B.to_arr(l0_vals), bk), bk)
-    ctx.llast = dom.coeff_to_extended(dom.lagrange_to_coeff(B.to_arr(llast_vals), bk), bk)
-    ctx.lblind = dom.coeff_to_extended(dom.lagrange_to_coeff(B.to_arr(lblind_vals), bk), bk)
+    # l0 / l_last / l_blind on the extended coset — circuit-fixed, cached
+    # alongside the fixed-column extended forms
+    if ("l0",) not in pk_ext:
+        l0_vals = [0] * n
+        l0_vals[0] = 1
+        llast_vals = [0] * n
+        llast_vals[cfg.last_row] = 1
+        lblind_vals = [0] * n
+        for i in range(u + 1, n):
+            lblind_vals[i] = 1
+        for name, vals in (("l0", l0_vals), ("llast", llast_vals),
+                           ("lblind", lblind_vals)):
+            pk_ext[(name,)] = dom.coeff_to_extended(
+                dom.lagrange_to_coeff(B.to_arr(vals), bk), bk)
+    ctx.l0 = pk_ext[("l0",)]
+    ctx.llast = pk_ext[("llast",)]
+    ctx.lblind = pk_ext[("lblind",)]
 
     with phase("prove/quotient"):
         exprs = all_expressions(cfg, ctx, beta, gamma)
         acc = None
         for e in exprs:
-            acc = e if acc is None else bk.add(bk.scale(acc, y), e)
+            acc = e if acc is None else bk.axpy(acc, y, e)
         h_evals = bk.mul(acc, dom.vanishing_inv_on_extended())
         return dom.extended_to_coeff(h_evals, bk)

@@ -87,8 +87,21 @@ class ProverState:
                               transcript=KeccakTranscript())
         return outer, AggregationCircuit.get_instances(agg_args, self.spec)
 
+    def _release_idle_ext_caches(self, *active_pks):
+        """Drop cached extended-domain fixed columns on every pk EXCEPT the
+        ones about to prove: the per-pk caches are GBs at production degrees
+        and would otherwise stack across circuit families (all four pks
+        resident), raising the service's peak RSS well above one prove's."""
+        for pk in (self.step_pk, self.committee_pk,
+                   getattr(self, "step_agg_pk", None),
+                   getattr(self, "committee_agg_pk", None)):
+            if pk is not None and all(pk is not a for a in active_pks):
+                pk.release_ext_cache()
+
     def prove_step(self, args) -> tuple[bytes, list]:
         with self.semaphore:
+            self._release_idle_ext_caches(self.step_pk,
+                                          getattr(self, "step_agg_pk", None))
             if self.compress:
                 return self._compressed(StepCircuit, self.step_pk,
                                         self.k_step, self.step_agg,
@@ -117,6 +130,8 @@ class ProverState:
 
     def prove_committee(self, args) -> tuple[bytes, list]:
         with self.semaphore:
+            self._release_idle_ext_caches(
+                self.committee_pk, getattr(self, "committee_agg_pk", None))
             if self.compress:
                 return self._compressed(CommitteeUpdateCircuit,
                                         self.committee_pk, self.k_committee,
