@@ -10,11 +10,16 @@ zcash-halo2 Grain procedure the reference instantiates (`poseidon.rs:79`
 MSB-first round constants; non-rejected LSB-first MDS xs/ys (batch-retried on
 duplicates); Cauchy matrix 1/(x_i + y_j). The optimized-spec rewrite the Rust
 side applies for sparse partial rounds is an equivalence transform, so the
-naive schedule here produces identical permutation outputs. NOTE: final
-byte-parity vs pse-poseidon needs an oracle this offline environment lacks
-(no Rust toolchain, no vendored crate, no published T=12 vectors); golden
-vectors of THIS derivation are pinned in tests/test_ops.py so any future
-drift is loud, and the derivation is cross-checkable the moment an oracle
+naive schedule here produces identical permutation outputs. NOTE on external
+parity: the reference snapshot contains NO reproducible (committee, poseidon)
+pair — audited round 5: the only external Poseidon artifact anywhere in it is
+`.env.example`'s INITIAL_COMMITTEE_POSEIDON (Sepolia period 10), whose
+preimage committee lives behind a beacon API this offline environment cannot
+reach; `poseidon.rs` has no unit vectors, and every fixture computes its
+commitment at runtime. Offline evidence is therefore: (a) an independent
+integer-register Grain re-derivation matching bit-for-bit
+(tests/test_ops.py::TestGrainSecondSource), and (b) golden vectors of this
+derivation pinned so any drift is loud. Cross-checkable the moment an oracle
 appears.
 
 The sponge construction (rate-11 "onion" absorb over committee pubkeys) lives
