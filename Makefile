@@ -1,6 +1,6 @@
 # Build orchestration (reference parity: `justfile` recipes).
 
-.PHONY: all native test test-slow fixtures bench setup-committee setup-step lint
+.PHONY: all native test test-slow fixtures bench setup-committee setup-step lint tpu-evidence
 
 all: native
 
@@ -26,6 +26,12 @@ setup-step:
 
 bench: native
 	python bench.py
+
+# the full hardware-evidence suite, ordered cheap->expensive, every stage
+# deadline-guarded; safe (and labeled) under CPU-JAX when the tunnel is
+# wedged. Run the MOMENT a TPU probe succeeds.
+tpu-evidence: native
+	python scripts/tpu_evidence.py
 
 lint:
 	python -m compileall -q spectre_tpu tests bench.py __graft_entry__.py

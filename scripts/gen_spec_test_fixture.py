@@ -1,6 +1,8 @@
 #!/usr/bin/env python
-"""Generate the vendored consensus-spec-test fixture (official pyspec file
-format) for the Minimal preset. Deterministic; rerun to rebuild.
+"""Generate the vendored consensus-spec-test fixtures (official pyspec file
+format) for the Minimal preset — one directory per official case shape
+(happy path, multi-update, force-update cut, no-finality, skipped-period
+force-update opener). Deterministic; rerun to rebuild.
 
 Run: python scripts/gen_spec_test_fixture.py
 """
@@ -10,16 +12,30 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-from spectre_tpu.preprocessor.spec_tests import generate_spec_test
+from spectre_tpu.preprocessor.spec_tests import (SPEC_TEST_SCENARIOS,
+                                                 generate_spec_test)
 from spectre_tpu.spec import MINIMAL
 
-OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "consensus-spec-tests", "tests", "minimal", "capella",
-                   "light_client", "sync", "pyspec_tests",
-                   "light_client_sync_selfgen")
+ROOT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "consensus-spec-tests", "tests", "minimal", "capella",
+                    "light_client", "sync", "pyspec_tests")
+
+# scenario -> fixture dir name (official tests use descriptive snake_case
+# names; the _selfgen suffix marks vendored self-generated fixtures so real
+# downloaded vectors drop in alongside unchanged)
+DIRS = {
+    "sync": "light_client_sync_selfgen",
+    "multi_update": "multi_update_selfgen",
+    "force_update_cut": "force_update_cut_selfgen",
+    "no_finality": "process_update_no_finality_selfgen",
+    "force_update_only": "skipped_period_force_update_selfgen",
+}
 
 if __name__ == "__main__":
-    generate_spec_test(OUT, MINIMAL)
-    print("wrote", OUT)
-    for f in sorted(os.listdir(OUT)):
-        print(" ", f, os.path.getsize(os.path.join(OUT, f)), "bytes")
+    assert set(DIRS) == set(SPEC_TEST_SCENARIOS)
+    for scenario, name in DIRS.items():
+        out = os.path.join(ROOT, name)
+        generate_spec_test(out, MINIMAL, scenario=scenario)
+        print("wrote", out)
+        for f in sorted(os.listdir(out)):
+            print(" ", f, os.path.getsize(os.path.join(out, f)), "bytes")

@@ -135,14 +135,18 @@ def main():
     if opts.k_agg == "auto":
         # smallest k whose column count stays in the reference's envelope
         # (their verifier pins K=23 with 1 advice column at lookup 19)
+        cagg = None
         for k_agg in range(20, 25):
-            c = ctx.auto_config(k=k_agg,
-                                lookup_bits=agg_cls.default_lookup_bits)
-            if c.num_advice <= 12:
+            cagg = ctx.auto_config(k=k_agg,
+                                   lookup_bits=agg_cls.default_lookup_bits)
+            if cagg.num_advice <= 12:
                 break
+        assert cagg is not None and cagg.num_advice <= 12, \
+            f"no k in 20..24 reaches <=12 advice (k=24: {cagg.num_advice})"
     else:
         k_agg = int(opts.k_agg)
-    cagg = ctx.auto_config(k=k_agg, lookup_bits=agg_cls.default_lookup_bits)
+        cagg = ctx.auto_config(k=k_agg,
+                               lookup_bits=agg_cls.default_lookup_bits)
     record["k_agg"] = k_agg
     record["agg_config"] = {"num_advice": cagg.num_advice,
                             "num_lookup_advice": cagg.num_lookup_advice}
@@ -212,6 +216,16 @@ def main():
         "generated verifier accepted a tampered proof"
     record["evm_verifier_s"] = round(time.time() - t, 1)
     record["evm_verifier_ok"] = True
+    # static gas + deployed-size model (reference prints these from revm,
+    # `prover/src/cli.rs:249-277`; offline equivalent — evm/gas.py)
+    from spectre_tpu.evm import estimate_deployed_size, estimate_gas
+    g = estimate_gas(sol, calldata=calldata)
+    sz = estimate_deployed_size(sol)
+    record["gas_estimate"] = {k: v for k, v in g.items() if k != "counts"}
+    record["deployed_size_estimate"] = sz
+    log(f"gas estimate: {g.get('gas_total', g['gas_execution']):,} "
+        f"(execution {g['gas_execution']:,}); deployed size ~"
+        f"{sz['deployed_bytes_estimate']:,} B [{sz['deployed_size_risk']}]")
     save_record()
     log(f"DONE: record at {record_path}")
     print(json.dumps(record, indent=1))

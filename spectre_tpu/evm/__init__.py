@@ -6,3 +6,4 @@ Reference parity: snark-verifier's `gen_evm_verifier_shplonk` +
 """
 
 from .codegen import encode_calldata, gen_evm_verifier  # noqa: F401
+from .gas import estimate_deployed_size, estimate_gas  # noqa: F401

@@ -239,11 +239,32 @@ class GindexTree:
         return out
 
 
-def generate_spec_test(test_dir: str, spec, seed: int = 7) -> None:
+#: scenario -> description; mirrors the official suite's case shapes
+#: (`consensus-specs` light_client/sync tests + `test-utils/src/lib.rs:64-85`
+#: cut semantics)
+SPEC_TEST_SCENARIOS = {
+    "sync": "single happy-path process_update (finality + committee branches)",
+    "multi_update": "two sequential process_update steps (updates_0/1); the "
+                    "loader must return BOTH in order",
+    "force_update_cut": "process_update followed by a force_update step; "
+                        "valid_updates_from_test_path must CUT after the "
+                        "first update",
+    "no_finality": "process_update whose update carries NO finalized header "
+                   "(zeroed header + zero branch, the official "
+                   "no-finality shape); witness pre-verification must reject",
+    "force_update_only": "fixture OPENING with force_update (skipped-period "
+                         "shape): no provable prefix, the loader must raise",
+}
+
+
+def generate_spec_test(test_dir: str, spec, seed: int = 7,
+                       scenario: str = "sync") -> None:
     """Write a self-consistent light_client/sync fixture in the official
     pyspec file format. The BLS signature is real (own keys), branches are
-    honest paths through sparse state trees."""
+    honest paths through sparse state trees. `scenario` selects one of the
+    official case shapes (SPEC_TEST_SCENARIOS)."""
     import yaml
+    assert scenario in SPEC_TEST_SCENARIOS, scenario
 
     n = spec.sync_committee_size
     cur_sks = [seed * 7919 + i + 1 for i in range(n)]
@@ -295,81 +316,165 @@ def generate_spec_test(test_dir: str, spec, seed: int = 7) -> None:
                        execution_branch=body_tree.branch(gindex_exec))
 
     period_start = 2 * spec.slots_per_period
-    # finalized header (its own state tree holds both committees, so the
-    # bootstrap taken at this header verifies too)
-    fin_state = GindexTree({spec.sync_committee_root_index - 1: cur_root,
-                            spec.sync_committee_root_index: nxt_root})
-    finalized = light_client_header(period_start + 8, 3, 0, fin_state.root())
-    fin_beacon_root = ssz.BEACON_BLOCK_HEADER.hash_tree_root(finalized.beacon)
-
-    # attested header: state holds finalized root @105, committees @54/55
-    att_state = GindexTree({
-        spec.finalized_header_index: fin_beacon_root,
-        spec.sync_committee_root_index - 1: cur_root,
-        spec.sync_committee_root_index: nxt_root,
-    })
-    attested = light_client_header(period_start + 16, 11, 1, att_state.root())
-    att_beacon_root = ssz.BEACON_BLOCK_HEADER.hash_tree_root(attested.beacon)
-
     gvr = _filler(3)
     domain = ssz.compute_domain(
         ssz.DOMAIN_SYNC_COMMITTEE, _fork_version(spec), gvr)
     from ..gadgets.ssz_merkle import sha256_pair_native
-    signing_root = sha256_pair_native(att_beacon_root, domain)
-    msg_point = bls.hash_to_g2(signing_root, spec.dst)
-    bits = [1] * n
-    sig = bls.aggregate_signatures(
-        [bls.g2_curve.mul(msg_point, sk) for sk, b in zip(cur_sks, bits) if b])
 
-    update = ssz.Obj(
-        attested_header=attested,
-        next_sync_committee=nxt_committee,
-        next_sync_committee_branch=att_state.branch(
-            spec.sync_committee_root_index),
-        finalized_header=finalized,
-        finality_branch=att_state.branch(spec.finalized_header_index),
-        sync_aggregate=ssz.Obj(sync_committee_bits=bits,
-                               sync_committee_signature=bls.g2_compress(sig)),
-        signature_slot=attested.beacon.slot + 1)
+    def zeroed_light_client_header() -> ssz.Obj:
+        """The official no-finality shape: an all-zero LightClientHeader."""
+        execution = ssz.Obj(
+            parent_hash=b"\x00" * 32, fee_recipient=b"\x00" * 20,
+            state_root=b"\x00" * 32, receipts_root=b"\x00" * 32,
+            logs_bloom=b"\x00" * spec.bytes_per_logs_bloom,
+            prev_randao=b"\x00" * 32, block_number=0, gas_limit=0,
+            gas_used=0, timestamp=0, extra_data=b"", base_fee_per_gas=0,
+            block_hash=b"\x00" * 32, transactions_root=b"\x00" * 32,
+            withdrawals_root=b"\x00" * 32)
+        beacon = ssz.Obj(slot=0, proposer_index=0, parent_root=b"\x00" * 32,
+                         state_root=b"\x00" * 32, body_root=b"\x00" * 32)
+        return ssz.Obj(beacon=beacon, execution=execution,
+                       execution_branch=[b"\x00" * 32]
+                       * spec.execution_state_root_depth)
 
+    def make_update(slot_off: int, tag_base: int, with_finality: bool = True):
+        """One signed LightClientUpdate at period_start+slot_off, with its
+        own finalized/attested headers over shared committees. Returns
+        (update, artifacts-dict for steps.yaml/bootstrap)."""
+        fin_state = GindexTree({spec.sync_committee_root_index - 1: cur_root,
+                                spec.sync_committee_root_index: nxt_root})
+        finalized = light_client_header(period_start + slot_off - 8, 3,
+                                        tag_base, fin_state.root())
+        fin_beacon_root = ssz.BEACON_BLOCK_HEADER.hash_tree_root(
+            finalized.beacon)
+        att_assigned = {
+            spec.sync_committee_root_index - 1: cur_root,
+            spec.sync_committee_root_index: nxt_root,
+        }
+        if with_finality:
+            att_assigned[spec.finalized_header_index] = fin_beacon_root
+        att_state = GindexTree(att_assigned)
+        attested = light_client_header(period_start + slot_off, 11,
+                                       tag_base + 1, att_state.root())
+        att_beacon_root = ssz.BEACON_BLOCK_HEADER.hash_tree_root(
+            attested.beacon)
+        signing_root = sha256_pair_native(att_beacon_root, domain)
+        msg_point = bls.hash_to_g2(signing_root, spec.dst)
+        bits = [1] * n
+        sig = bls.aggregate_signatures(
+            [bls.g2_curve.mul(msg_point, sk)
+             for sk, b in zip(cur_sks, bits) if b])
+        fin_branch = (att_state.branch(spec.finalized_header_index)
+                      if with_finality else
+                      [b"\x00" * 32] * spec.finalized_header_depth)
+        update = ssz.Obj(
+            attested_header=attested,
+            next_sync_committee=nxt_committee,
+            next_sync_committee_branch=att_state.branch(
+                spec.sync_committee_root_index),
+            finalized_header=(finalized if with_finality
+                              else zeroed_light_client_header()),
+            finality_branch=fin_branch,
+            sync_aggregate=ssz.Obj(sync_committee_bits=bits,
+                                   sync_committee_signature=bls.g2_compress(sig)),
+            signature_slot=attested.beacon.slot + 1)
+        return update, {
+            "finalized": finalized, "fin_state": fin_state,
+            "fin_beacon_root": fin_beacon_root,
+            "attested": attested, "att_beacon_root": att_beacon_root,
+        }
+
+    def process_update_step(idx: int, update: ssz.Obj, art: dict) -> dict:
+        fin = update.finalized_header
+        return {"process_update": {
+            "update_fork_digest": "0x" + _filler(4)[:4].hex(),
+            "update": f"updates_{idx}",
+            "current_slot": int(art["attested"].beacon.slot + 2),
+            "checks": {
+                "optimistic_header": {
+                    "slot": int(art["attested"].beacon.slot),
+                    "beacon_root": "0x" + art["att_beacon_root"].hex(),
+                    "execution_root": "0x" + exec_type.hash_tree_root(
+                        art["attested"].execution).hex(),
+                },
+                "finalized_header": {
+                    "slot": int(fin.beacon.slot),
+                    "beacon_root": "0x" + ssz.BEACON_BLOCK_HEADER
+                    .hash_tree_root(fin.beacon).hex(),
+                    "execution_root": "0x" + exec_type.hash_tree_root(
+                        fin.execution).hex(),
+                },
+            },
+        }}
+
+    def force_update_step(current_slot: int) -> dict:
+        # official shape: advance past the update timeout with no
+        # process_update (`TestStep::ForceUpdate`, ref test_types)
+        return {"force_update": {
+            "current_slot": int(current_slot),
+            "checks": {},
+        }}
+
+    # -- assemble per scenario --
+    updates: list = []       # (update, artifacts), files updates_<i>
+    steps: list = []
+    if scenario == "sync":
+        u, a = make_update(16, 0)
+        updates, steps = [(u, a)], [process_update_step(0, u, a)]
+    elif scenario == "multi_update":
+        u0, a0 = make_update(16, 0)
+        u1, a1 = make_update(32, 10)
+        updates = [(u0, a0), (u1, a1)]
+        steps = [process_update_step(0, u0, a0),
+                 process_update_step(1, u1, a1)]
+    elif scenario == "force_update_cut":
+        u, a = make_update(16, 0)
+        updates = [(u, a)]
+        steps = [process_update_step(0, u, a),
+                 force_update_step(a["attested"].beacon.slot
+                                   + spec.slots_per_period)]
+    elif scenario == "no_finality":
+        u, a = make_update(16, 0, with_finality=False)
+        updates, steps = [(u, a)], [process_update_step(0, u, a)]
+    elif scenario == "force_update_only":
+        # a provable update file may exist on disk, but the step sequence
+        # OPENS with force_update — nothing for Spectre to prove
+        u, a = make_update(16, 0)
+        updates = [(u, a)]
+        steps = [force_update_step(a["attested"].beacon.slot + 2),
+                 process_update_step(0, u, a)]
+
+    # bootstrap anchored at the first update's finalized header (its state
+    # tree holds both committees, so the bootstrap branch verifies)
+    _, a0 = updates[0]
     bootstrap = ssz.Obj(
-        header=finalized,
+        header=a0["finalized"],
         current_sync_committee=cur_committee,
-        current_sync_committee_branch=fin_state.branch(
+        current_sync_committee_branch=a0["fin_state"].branch(
             spec.sync_committee_root_index - 1))
 
     os.makedirs(test_dir, exist_ok=True)
     dump_snappy_ssz(os.path.join(test_dir, "bootstrap.ssz_snappy"),
                     ssz.light_client_bootstrap(spec), bootstrap)
-    dump_snappy_ssz(os.path.join(test_dir, "updates_0.ssz_snappy"),
-                    ssz.light_client_update(spec), update)
-
-    exec_root_hex = "0x" + exec_type.hash_tree_root(finalized.execution).hex()
-    steps = [{"process_update": {
-        "update_fork_digest": "0x" + _filler(4)[:4].hex(),
-        "update": "updates_0",
-        "current_slot": int(attested.beacon.slot + 2),
-        "checks": {
-            "optimistic_header": {
-                "slot": int(attested.beacon.slot),
-                "beacon_root": "0x" + att_beacon_root.hex(),
-                "execution_root": "0x" + exec_type.hash_tree_root(
-                    attested.execution).hex(),
-            },
-            "finalized_header": {
-                "slot": int(finalized.beacon.slot),
-                "beacon_root": "0x" + fin_beacon_root.hex(),
-                "execution_root": exec_root_hex,
-            },
-        },
-    }}]
+    for i, (u, _) in enumerate(updates):
+        dump_snappy_ssz(os.path.join(test_dir, f"updates_{i}.ssz_snappy"),
+                        ssz.light_client_update(spec), u)
     with open(os.path.join(test_dir, "steps.yaml"), "w") as f:
         yaml.safe_dump(steps, f, sort_keys=False)
     meta = {
         "genesis_validators_root": "0x" + gvr.hex(),
-        "trusted_block_root": "0x" + fin_beacon_root.hex(),
+        "trusted_block_root": "0x" + a0["fin_beacon_root"].hex(),
         "bootstrap_fork_digest": "0x" + _filler(4)[:4].hex(),
         "store_fork_digest": "0x" + _filler(4)[:4].hex(),
     }
     with open(os.path.join(test_dir, "meta.yaml"), "w") as f:
         yaml.safe_dump(meta, f, sort_keys=False)
+
+
+def update_has_finality(step_args: SyncStepArgs) -> bool:
+    """False for the official no-finality update shape (zeroed finalized
+    header + zero branch): Spectre proves only finalized updates, so
+    witness pre-verification is expected to REJECT such witnesses."""
+    fh = step_args.finalized_header
+    return not (fh.slot == 0 and fh.state_root == b"\x00" * 32
+                and all(b == b"\x00" * 32 for b in step_args.finality_branch))

@@ -164,3 +164,48 @@ class TestCalldata:
         assert int.from_bytes(cd[4 + 128:4 + 160], "big") == len(proof)
         assert cd[4 + 160:4 + 160 + len(proof)] == proof
         assert len(cd) % 32 == 4
+
+
+class TestGasAndSizeEstimation:
+    """Static gas/deployed-size model (evm/gas.py; reference prints these
+    from revm, `prover/src/cli.rs:249-277`)."""
+
+    def test_counts_and_gas_on_generated_verifier(self, setup):
+        from spectre_tpu.evm import estimate_deployed_size, estimate_gas
+        _, pk, out, proof, src = setup
+        cd = encode_calldata([out], proof)
+        g = estimate_gas(src, calldata=cd)
+        c = g["counts"]
+        # the verifier must contain the structural minimum: a pairing, the
+        # SHPLONK W/W' ecMuls, transcript keccaks, and the identity's mulmods
+        assert c["pairing"] >= 1
+        assert c["ecmul"] >= 2
+        assert c["keccak"] >= 3
+        assert c["mulmod"] > 10
+        assert g["gas_precompiles"] >= 45000 + 34000 * 2
+        assert g["gas_total"] > g["gas_execution"] > 0
+        assert g["gas_intrinsic"] >= 21000
+        sz = estimate_deployed_size(src)
+        assert sz["deployed_bytes_estimate"] > 2200
+        assert sz["deployed_size_risk"] in ("ok", "tight", "exceeds-eip170")
+
+    def test_flagship_scale_verifier_size_assessment(self):
+        """The archived flagship aggregation verifier (107KB source) gets a
+        concrete EIP-170 assessment instead of an unknown."""
+        import glob
+        import os
+        from spectre_tpu.evm import estimate_deployed_size
+        cands = sorted(glob.glob(os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "build", "**", "aggregation_sync_step_*_verifier.sol"),
+            recursive=True))
+        if not cands:
+            import pytest
+            pytest.skip("no flagship verifier source in build/")
+        with open(cands[-1]) as f:
+            src = f.read()
+        sz = estimate_deployed_size(src)
+        # record-keeping assertion: the estimate must be decided, whatever
+        # the verdict — the flagship record embeds it
+        assert sz["deployed_size_risk"] in ("ok", "tight", "exceeds-eip170")
+        assert sz["deployed_bytes_estimate"] > 0

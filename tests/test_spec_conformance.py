@@ -37,14 +37,17 @@ def spec_test_dirs():
 
 def provable_test_dirs():
     """Fixtures Spectre can prove: those opening with process_update steps
-    (reference cuts at the first force_update, `test-utils/src/lib.rs:64-66`)."""
+    (reference cuts at the first force_update, `test-utils/src/lib.rs:64-66`)
+    whose first update carries finality (Spectre proves only finalized
+    updates; the official no-finality shape is covered separately)."""
     out = []
     for d in spec_test_dirs():
         try:
-            spec_tests.read_test_files_and_gen_witness(d, MINIMAL)
+            step_args, _ = spec_tests.read_test_files_and_gen_witness(d, MINIMAL)
         except ValueError:
             continue
-        out.append(d)
+        if spec_tests.update_has_finality(step_args):
+            out.append(d)
     return out
 
 
@@ -101,12 +104,96 @@ class TestSSZCodec(unittest.TestCase):
             bv.decode(b"\xff")  # bits 4..7 set
 
 
+def _dir(name: str) -> str:
+    return os.path.join(os.path.dirname(SPEC_TEST_GLOB), name)
+
+
+class TestLoaderCaseShapes(unittest.TestCase):
+    """The official suite's non-happy-path shapes, each exercising a loader
+    branch (`test-utils/src/lib.rs:64-85` semantics)."""
+
+    def test_force_update_cut(self):
+        """Steps = [process_update, force_update]: the valid-updates cut
+        keeps exactly the leading process_update."""
+        d = _dir("force_update_cut_selfgen")
+        if not os.path.isdir(d):
+            self.skipTest("fixture not vendored")
+        from spectre_tpu.test_utils import read_spec_test_steps
+        kinds = [k for k, _ in read_spec_test_steps(d)]
+        self.assertEqual(kinds, ["process_update", "force_update"])
+        updates = spec_tests.valid_updates_from_test_path(d, MINIMAL)
+        self.assertEqual(len(updates), 1)
+
+    def test_multi_update_sequence(self):
+        """Two sequential process_update steps load IN ORDER."""
+        d = _dir("multi_update_selfgen")
+        if not os.path.isdir(d):
+            self.skipTest("fixture not vendored")
+        updates = spec_tests.valid_updates_from_test_path(d, MINIMAL)
+        self.assertEqual(len(updates), 2)
+        self.assertLess(updates[0].attested_header.beacon.slot,
+                        updates[1].attested_header.beacon.slot)
+        # each update independently converts to a verifiable witness
+        bootstrap = spec_tests.load_snappy_ssz(
+            os.path.join(d, "bootstrap.ssz_snappy"),
+            ssz.light_client_bootstrap(MINIMAL))
+        meta = spec_tests.read_meta(d)
+        gvr = bytes.fromhex(meta["genesis_validators_root"].replace("0x", ""))
+        for u in updates:
+            args = spec_tests.to_sync_circuit_witness(
+                MINIMAL, bootstrap.current_sync_committee, u, gvr)
+            pts = [(bls.Fq(x), bls.Fq(y)) for (x, y), b in
+                   zip(args.pubkeys_uncompressed, args.participation_bits) if b]
+            sig = bls.g2_decompress(args.signature_compressed)
+            self.assertTrue(bls.fast_aggregate_verify(
+                pts, args.signing_root(), sig, dst=MINIMAL.dst))
+
+    def test_force_update_opener_not_provable(self):
+        """A fixture OPENING with force_update (skipped-period shape) has no
+        provable prefix: the loader must raise, not mis-prove."""
+        d = _dir("skipped_period_force_update_selfgen")
+        if not os.path.isdir(d):
+            self.skipTest("fixture not vendored")
+        self.assertEqual(
+            spec_tests.valid_updates_from_test_path(d, MINIMAL), [])
+        with self.assertRaises(ValueError):
+            spec_tests.read_test_files_and_gen_witness(d, MINIMAL)
+
+    def test_no_finality_update_rejected_by_preverification(self):
+        """The official no-finality update shape converts to a witness whose
+        zeroed finality branch must FAIL native pre-verification (Spectre
+        proves only finalized updates)."""
+        d = _dir("process_update_no_finality_selfgen")
+        if not os.path.isdir(d):
+            self.skipTest("fixture not vendored")
+        step_args, rot_args = \
+            spec_tests.read_test_files_and_gen_witness(d, MINIMAL)
+        self.assertFalse(spec_tests.update_has_finality(step_args))
+        with self.assertRaises(AssertionError):
+            spec_tests.verify_witness_branches(MINIMAL, step_args, rot_args)
+        # ... but the signature over the attested header is still real
+        pts = [(bls.Fq(x), bls.Fq(y)) for (x, y), b in
+               zip(step_args.pubkeys_uncompressed,
+                   step_args.participation_bits) if b]
+        sig = bls.g2_decompress(step_args.signature_compressed)
+        self.assertTrue(bls.fast_aggregate_verify(
+            pts, step_args.signing_root(), sig, dst=MINIMAL.dst))
+
+
 class TestSpecConformance(unittest.TestCase):
     """The loader is live: every vendored/downloaded fixture dir is walked."""
 
     def test_fixture_dirs_exist(self):
         self.assertTrue(spec_test_dirs(),
                         "no consensus-spec-tests fixtures vendored")
+
+    def test_all_case_shapes_vendored(self):
+        names = {os.path.basename(d) for d in spec_test_dirs()}
+        for want in ("light_client_sync_selfgen", "multi_update_selfgen",
+                     "force_update_cut_selfgen",
+                     "process_update_no_finality_selfgen",
+                     "skipped_period_force_update_selfgen"):
+            self.assertIn(want, names)
 
     def test_witness_generation_and_native_checks(self):
         for d in provable_test_dirs():
