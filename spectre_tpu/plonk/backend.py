@@ -163,6 +163,8 @@ class TpuBackend(CpuBackend):
         import os
         self._shard_min_logn = int(os.environ.get(
             "SPECTRE_SHARD_MSM_MIN_LOGN", str(self.SHARD_MSM_MIN_LOGN)))
+        self._shard_ntt_min_logn = int(os.environ.get(
+            "SPECTRE_SHARD_NTT_MIN_LOGN", str(self.SHARD_NTT_MIN_LOGN)))
 
     def _encode_points(self, points):
         import jax
@@ -215,7 +217,7 @@ class TpuBackend(CpuBackend):
         from ..ops import ec, limbs as L16, msm as MSM
 
         m = min(points.shape[0], scalars.shape[0])
-        if jax.local_device_count() > 1 and m >= (1 << self._shard_min_logn):
+        if self._use_mesh(m, self._shard_min_logn):
             return self._msm_sharded(points, scalars, m)
         pts = self._base_points(points, m)
         sc16 = jnp.asarray(L16.u64limbs_to_u16limbs(scalars[:m]))
@@ -279,11 +281,24 @@ class TpuBackend(CpuBackend):
             return list(ec.decode_points(np.asarray(res)))
         return [self.msm(points, s) for s in scalars_list]
 
+    # NTTs at least this large ride the four-step mesh-sharded kernel
+    # (all-to-all transpose over ICI, parallel/sharded_ntt.py) when >1
+    # device is attached — the same gate pattern as SHARD_MSM_MIN_LOGN;
+    # override via SPECTRE_SHARD_NTT_MIN_LOGN (the mesh-prove dryrun/test
+    # forces it low so a full tiny prove exercises the path end-to-end)
+    SHARD_NTT_MIN_LOGN = 18
+
+    def _use_mesh(self, n: int, min_logn: int) -> bool:
+        import jax
+        return jax.local_device_count() > 1 and n >= (1 << min_logn)
+
     def ntt(self, coeffs, omega: int):
         import jax.numpy as jnp
 
         from ..ops import field_ops as F, limbs as L16, ntt as NTT
 
+        if self._use_mesh(coeffs.shape[0], self._shard_ntt_min_logn):
+            return self._ntt_sharded(coeffs, omega)
         ctx = F.fr_ctx()
         mont = _u64_std_to_mont16(coeffs)
         out = NTT.ntt(jnp.asarray(mont), omega)
@@ -294,9 +309,31 @@ class TpuBackend(CpuBackend):
 
         from ..ops import field_ops as F, limbs as L16, ntt as NTT
 
+        if self._use_mesh(evals.shape[0], self._shard_ntt_min_logn):
+            n = evals.shape[0]
+            res = self._ntt_sharded(evals, pow(omega, -1, R), mont_out=True)
+            from ..ops import field_ops as Fo
+            ctx = Fo.fr_ctx()
+            ninv = ctx.encode([pow(n, -1, R)])[0]
+            out = Fo.mont_mul(ctx, res, jnp.asarray(ninv)[None])
+            return _mont16_to_u64_std(np.asarray(out))
         mont = _u64_std_to_mont16(evals)
         out = NTT.intt(jnp.asarray(mont), omega)
         return _mont16_to_u64_std(np.asarray(out))
+
+    def _ntt_sharded(self, arr_u64, omega: int, mont_out: bool = False):
+        """One NTT over the ("data",) mesh axis; exact same result as the
+        single-device kernel (pinned by tests/test_parallel.py)."""
+        import jax.numpy as jnp
+
+        from ..parallel.mesh import default_mesh
+        from ..parallel.sharded_ntt import sharded_ntt
+
+        mont = _u64_std_to_mont16(arr_u64)
+        res = sharded_ntt(jnp.asarray(mont), omega, default_mesh())
+        if mont_out:
+            return res
+        return _mont16_to_u64_std(np.asarray(res))
 
 
 def _u64_std_to_mont16(arr):

@@ -153,3 +153,50 @@ def read_spec_test_steps(test_dir: str):
         elif "force_update" in step:
             out.append(("force_update", step["force_update"]))
     return out
+
+
+def mesh_prove_fixture(k: int = 13):
+    """Deterministic circuit + assignment for the MESH-PROVE byte-equality
+    check: a complete prove must run on a multi-device mesh (sharded MSM +
+    sharded NTT riding the TpuBackend gates) and produce bytes IDENTICAL to
+    the single-device/host prove under the same seeded blinding
+    (SURVEY §2c(a); exercised by __graft_entry__.dryrun_multichip phase 4
+    and tests/test_parallel.py). Returns (srs, pk, assignment).
+
+    Shapes here are the contract: the dryrun and the RUN_SLOW test must use
+    THE SAME k so the persistent compile cache is shared."""
+    from .builder.context import Context
+    from .builder.gate import GateChip
+    from .builder.range_chip import RangeChip
+    from .plonk import backend as B
+    from .plonk.keygen import keygen
+    from .plonk.srs import SRS
+
+    ctx = Context()
+    gate = GateChip()
+    rng = RangeChip(8, gate)
+    acc = ctx.load_zero()
+    for i in range(1500):
+        v = ctx.load_witness((i * 7 + 3) % 251)
+        rng.range_check(ctx, v, 8)
+        acc = gate.add(ctx, acc, v)
+    ctx.expose_public(acc)
+    cfg = ctx.auto_config(k=k, lookup_bits=8)
+    asg = ctx.assignment(cfg)
+    srs = SRS.load_or_setup(k)
+    pk = keygen(srs, cfg, asg.fixed, asg.selectors, asg.copies,
+                B.CpuBackend())
+    return srs, pk, asg
+
+
+def seeded_blinding_rng(seed: int = 12345):
+    """Deterministic stand-in for the ZK blinding source: makes a proof a
+    pure function of (pk, witness, transcript) so backend/mesh byte-equality
+    is checkable. NEVER use in production proving."""
+    state = [seed]
+
+    def rng():
+        state[0] += 1
+        return (state[0] * 0x9E3779B97F4A7C15) % (2**61 - 1)
+
+    return rng
