@@ -1,0 +1,220 @@
+"""Shared driver for the compressed two-stage production flows.
+
+Both production methods (`genEvmProof_SyncStepCompressed` and
+`genEvmProof_CommitteeUpdateCompressed`, `prover/src/rpc.rs:46-163`) are the
+same pipeline over different inner circuits:
+
+    stage 1: inner app-circuit prove (Poseidon transcript) at --spec/--k
+    stage 2: AggregationCircuit outer prove (Keccak transcript) at auto-k
+    finish:  calldata + generated Solidity verifier execution + static
+             gas / deployed-size estimates
+
+`run_compressed_flow` is that pipeline, parameterized by the inner circuit.
+Checkpoints land in build/ so a crashed run resumes (inner/outer proofs are
+regenerated only when absent). The per-circuit scripts
+(prove_step_compressed.py, prove_committee_compressed.py) are thin arg
+wrappers over this module.
+"""
+import json
+import os
+import time
+
+T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time()-T0:8.1f}s] {msg}", flush=True)
+
+
+def run_compressed_flow(circuit_cls, default_args_fn, *, spec, k: int,
+                        k_agg="auto", k_agg_range=(20, 25),
+                        max_agg_cells: float = 90e6, max_agg_advice: int = 12,
+                        record_name: str, inner_proof_name: str,
+                        outer_proof_name: str, verifier_name: str,
+                        contract_name: str, stop_after: str = "all",
+                        tamper_byte: int = 37) -> dict:
+    """The two-stage flow end-to-end; returns the record dict."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from spectre_tpu.plonk.backend import setup_compile_cache
+    setup_compile_cache()
+
+    from spectre_tpu.models import AggregationArgs, AggregationCircuit
+    from spectre_tpu.models.app_circuit import BUILD_DIR
+    from spectre_tpu.plonk.srs import SRS
+    from spectre_tpu.plonk.transcript import (KeccakTranscript,
+                                              PoseidonTranscript)
+    from spectre_tpu.plonk.verifier import verify as plonk_verify
+
+    record_path = os.path.join(BUILD_DIR, record_name)
+    record = {"spec": spec.name, f"k_{circuit_cls.name}": k}
+    if os.path.exists(record_path):
+        with open(record_path) as f:
+            record.update(json.load(f))
+        # drop pre-refactor schema keys so a resumed record can't carry a
+        # stale config next to the live one
+        for stale in ("k_step", "step_config", "k_committee",
+                      "committee_config"):
+            record.pop(stale, None)
+
+    def save_record():
+        with open(record_path, "w") as f:
+            json.dump(record, f, indent=1)
+
+    args = default_args_fn(spec)
+    log(f"fixture ready ({spec.sync_committee_size} pubkeys)")
+
+    # ---- stage 1: inner snark (Poseidon transcript) ----
+    srs = SRS.load_or_setup(k)
+    log(f"srs k={k}")
+    t = time.time()
+    pk = circuit_cls.create_pk(srs, spec, k, args)
+    record.setdefault("keygen_s", round(time.time() - t, 1))
+    cfg = pk.vk.config
+    log(f"{circuit_cls.name} pk ready: advice={cfg.num_advice} "
+        f"lookup={cfg.num_lookup_advice} sha_slots={cfg.num_sha_slots}")
+    record["inner_config"] = {
+        "num_advice": cfg.num_advice,
+        "num_lookup_advice": cfg.num_lookup_advice,
+        "lookup_bits": cfg.lookup_bits, "num_sha_slots": cfg.num_sha_slots}
+    save_record()
+
+    proof_path = os.path.join(BUILD_DIR, inner_proof_name)
+    inst = circuit_cls.get_instances(args, spec)
+    if os.path.exists(proof_path):
+        with open(proof_path, "rb") as f:
+            proof = f.read()
+        log(f"stage-1 proof loaded from cache ({len(proof)} bytes)")
+    else:
+        t = time.time()
+        proof = circuit_cls.prove(pk, srs, args, spec,
+                                  transcript=PoseidonTranscript())
+        record["stage1_prove_s"] = round(time.time() - t, 1)
+        with open(proof_path, "wb") as f:
+            f.write(proof)
+        log(f"STAGE-1 PROOF: {len(proof)} bytes in "
+            f"{record['stage1_prove_s']}s")
+    record["stage1_proof_bytes"] = len(proof)
+    t = time.time()
+    ok = plonk_verify(pk.vk, srs, [inst], proof,
+                      transcript_cls=PoseidonTranscript)
+    assert ok, "stage-1 proof does not verify"
+    record["stage1_verify_s"] = round(time.time() - t, 1)
+    log(f"stage-1 verifies ({record['stage1_verify_s']}s)")
+    save_record()
+    if stop_after == "inner":
+        return record
+
+    # ---- stage 2: aggregation over the inner snark ----
+    agg_cls = AggregationCircuit.variant(circuit_cls.name)
+    agg_args = AggregationArgs(inner_vk=pk.vk, srs=srs,
+                               inner_instances=[inst], proof=proof)
+    t = time.time()
+    ctx = agg_cls.build_context(agg_args, spec)
+    st = ctx.stats()
+    record["agg_build_s"] = round(time.time() - t, 1)
+    record["agg_advice_cells"] = st["advice_cells"]
+    record["agg_lookup_cells"] = sum(st["lookup_cells"].values())
+    log(f"agg circuit built in {record['agg_build_s']}s: "
+        f"{st['advice_cells']:,} advice cells, "
+        f"{record['agg_lookup_cells']:,} lookup cells")
+    save_record()
+    assert st["advice_cells"] <= max_agg_cells, \
+        f"aggregation circuit too large ({st['advice_cells']:,} cells)"
+
+    if k_agg == "auto":
+        cagg = None
+        for k_agg in range(*k_agg_range):
+            cagg = ctx.auto_config(k=k_agg,
+                                   lookup_bits=agg_cls.default_lookup_bits)
+            if cagg.num_advice <= max_agg_advice:
+                break
+        assert cagg is not None and cagg.num_advice <= max_agg_advice, \
+            f"no k in {k_agg_range[0]}..{k_agg_range[1] - 1} meets " \
+            f"max_agg_advice={max_agg_advice}" + \
+            (f" (k={k_agg} needs {cagg.num_advice} advice)" if cagg else "")
+    else:
+        k_agg = int(k_agg)
+        cagg = ctx.auto_config(k=k_agg,
+                               lookup_bits=agg_cls.default_lookup_bits)
+    record["k_agg"] = k_agg
+    record["agg_config"] = {"num_advice": cagg.num_advice,
+                            "num_lookup_advice": cagg.num_lookup_advice}
+    log(f"agg k={k_agg}: advice={cagg.num_advice} "
+        f"lookup={cagg.num_lookup_advice}")
+    save_record()
+    if stop_after == "agg-build":
+        return record
+
+    srs_agg = SRS.load_or_setup(k_agg)
+    log(f"srs k={k_agg}")
+    t = time.time()
+    agg_pk = agg_cls.create_pk(srs_agg, spec, k_agg, agg_args)
+    record.setdefault("agg_keygen_s", round(time.time() - t, 1))
+    log("agg pk ready")
+    save_record()
+
+    # proof/verifier names may carry a {k_agg} placeholder (auto-k flows)
+    oproof_path = os.path.join(BUILD_DIR,
+                               outer_proof_name.format(k_agg=k_agg))
+    if os.path.exists(oproof_path):
+        with open(oproof_path, "rb") as f:
+            oproof = f.read()
+        with open(oproof_path + ".instances.json") as f:
+            stmt = [int(v, 16) for v in json.load(f)["instances"]]
+        log(f"stage-2 proof loaded from cache ({len(oproof)} bytes)")
+    else:
+        stmt = AggregationCircuit.get_instances(agg_args, spec)
+        t = time.time()
+        oproof = agg_cls.prove(agg_pk, srs_agg, agg_args, spec,
+                               transcript=KeccakTranscript())
+        record["stage2_prove_s"] = round(time.time() - t, 1)
+        with open(oproof_path, "wb") as f:
+            f.write(oproof)
+        with open(oproof_path + ".instances.json", "w") as f:
+            json.dump({"instances": [hex(v) for v in stmt]}, f)
+        log(f"STAGE-2 PROOF: {len(oproof)} bytes in "
+            f"{record['stage2_prove_s']}s")
+    record["stage2_proof_bytes"] = len(oproof)
+    t = time.time()
+    ok = agg_cls.verify(agg_pk.vk, srs_agg, stmt, oproof,
+                        transcript_cls=KeccakTranscript)
+    assert ok, "outer proof (incl. deferred pairing) does not verify"
+    record["stage2_verify_s"] = round(time.time() - t, 1)
+    log(f"stage-2 verifies incl. deferred KZG pairing "
+        f"({record['stage2_verify_s']}s)")
+    save_record()
+
+    # ---- EVM artifact: calldata + generated verifier + gas model ----
+    from spectre_tpu.evm import (encode_calldata, estimate_deployed_size,
+                                 estimate_gas, gen_evm_verifier)
+    from spectre_tpu.evm.simulator import run_verifier
+    calldata = encode_calldata(stmt, oproof)
+    record["calldata_bytes"] = len(calldata)
+    t = time.time()
+    sol = gen_evm_verifier(agg_pk.vk, srs_agg, num_instances=len(stmt),
+                           contract_name=contract_name, num_acc_limbs=12)
+    sol_path = os.path.join(BUILD_DIR, verifier_name.format(k_agg=k_agg))
+    with open(sol_path, "w") as f:
+        f.write(sol)
+    record["verifier_sol_bytes"] = len(sol)
+    log(f"EVM verifier generated: {len(sol)} bytes source")
+    ok = run_verifier(sol, stmt, oproof)
+    assert ok, "generated Solidity verifier rejected the outer proof"
+    bad = bytearray(oproof)
+    bad[tamper_byte] ^= 1
+    assert not run_verifier(sol, stmt, bytes(bad)), \
+        "generated verifier accepted a tampered proof"
+    record["evm_verifier_s"] = round(time.time() - t, 1)
+    record["evm_verifier_ok"] = True
+    g = estimate_gas(sol, calldata=calldata)
+    sz = estimate_deployed_size(sol)
+    record["gas_estimate"] = {kk: v for kk, v in g.items() if kk != "counts"}
+    record["deployed_size_estimate"] = sz
+    log(f"gas estimate: {g.get('gas_total', g['gas_execution']):,}; "
+        f"deployed ~{sz['deployed_bytes_estimate']:,} B "
+        f"[{sz['deployed_size_risk']}]")
+    save_record()
+    log(f"DONE: record at {record_path}")
+    print(json.dumps(record, indent=1))
+    return record

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""The reference's SECOND production method end-to-end: committee-update at
+reference scale through the COMPRESSED two-stage flow.
+
+Reference parity: `genEvmProof_CommitteeUpdateCompressed`
+(`prover/src/rpc.rs:46,55-113`) with K=24-class outer pinning
+(`config/committee_update_verifier_24.json`, `justfile:19-21`). The wide-SHA
+stage-1 proof carries ~114 region commitments, so the in-circuit verifier is
+materially bigger than the step flow's — the reference pays the same cost
+with its large outer K; it is recorded honestly here rather than redesigned
+away (VERDICT r4 item 2 options).
+
+Run:
+    JAX_PLATFORMS=cpu SPECTRE_TRACE=1 \
+        python scripts/prove_committee_compressed.py \
+        [--spec testnet] [--k 18] [--k-agg auto] [--max-agg-cells 120e6] \
+        [--max-agg-advice 16]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SPECTRE_TRACE", "1")
+
+from _compressed_flow import run_compressed_flow  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--spec", default="testnet")
+    ap.add_argument("--k", type=int, default=18)
+    ap.add_argument("--k-agg", default="auto")
+    ap.add_argument("--max-agg-cells", type=float, default=120e6)
+    ap.add_argument("--max-agg-advice", type=int, default=16)
+    ap.add_argument("--stop-after", choices=["inner", "agg-build", "all"],
+                    default="all")
+    opts = ap.parse_args()
+
+    from spectre_tpu import spec as S
+    from spectre_tpu.models import CommitteeUpdateCircuit
+    from spectre_tpu.witness.rotation import default_committee_update_args
+
+    spec = S.SPECS[opts.spec]
+    k = opts.k
+    run_compressed_flow(
+        CommitteeUpdateCircuit, default_committee_update_args,
+        spec=spec, k=k, k_agg=opts.k_agg,
+        # the reference accepts a LARGE outer K for this flow (K=24); cap
+        # columns rather than rows
+        k_agg_range=(21, 26),
+        max_agg_cells=opts.max_agg_cells,
+        max_agg_advice=opts.max_agg_advice,
+        record_name=f"compressed_committee_{spec.name}_{k}.json",
+        inner_proof_name=f"committee_{spec.name}_{k}_poseidon.proof",
+        outer_proof_name=f"agg_committee_{spec.name}_{{k_agg}}_keccak.proof",
+        verifier_name=(f"aggregation_committee_{spec.name}"
+                       "_{k_agg}_verifier.sol"),
+        contract_name="Verifier_aggregation_committee",
+        stop_after=opts.stop_after,
+        tamper_byte=41)
+
+
+if __name__ == "__main__":
+    main()

@@ -5,6 +5,7 @@ the COMPRESSED two-stage flow.
     stage 1: StepCircuit prove (Poseidon transcript) at --spec/--k
     stage 2: AggregationCircuit outer prove (Keccak transcript) at auto-k
     finish:  encode_calldata + generated Solidity verifier accepts the proof
+             + static gas / deployed-size estimates
 
 Reference parity: the `genEvmProof_SyncStepCompressed` path
 (`prover/src/rpc.rs:114-163`) and the full two-stage test
@@ -17,20 +18,14 @@ outer proof are only regenerated when absent. Run:
         [--spec testnet] [--k 21] [--k-agg auto] [--max-agg-cells 90e6]
 """
 import argparse
-import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("SPECTRE_TRACE", "1")
 
-T0 = time.time()
-
-
-def log(msg):
-    print(f"[{time.time()-T0:8.1f}s] {msg}", flush=True)
+from _compressed_flow import run_compressed_flow  # noqa: E402
 
 
 def main():
@@ -39,196 +34,33 @@ def main():
     ap.add_argument("--k", type=int, default=21)
     ap.add_argument("--k-agg", default="auto")
     ap.add_argument("--max-agg-cells", type=float, default=90e6)
+    ap.add_argument("--max-agg-advice", type=int, default=12)
     ap.add_argument("--stop-after", choices=["inner", "agg-build", "all"],
                     default="all")
     opts = ap.parse_args()
 
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    from spectre_tpu.plonk.backend import setup_compile_cache
-    setup_compile_cache()
-
     from spectre_tpu import spec as S
-    from spectre_tpu.models import AggregationArgs, AggregationCircuit
-    from spectre_tpu.models.app_circuit import BUILD_DIR
     from spectre_tpu.models.step import StepCircuit
-    from spectre_tpu.plonk.srs import SRS
-    from spectre_tpu.plonk.transcript import (KeccakTranscript,
-                                              PoseidonTranscript)
     from spectre_tpu.witness.step import default_sync_step_args
 
     spec = S.SPECS[opts.spec]
     k = opts.k
-    record_path = os.path.join(BUILD_DIR, f"compressed_{spec.name}_{k}.json")
-    record = {"spec": spec.name, "k_step": k}
-    if os.path.exists(record_path):
-        with open(record_path) as f:
-            record.update(json.load(f))
-
-    def save_record():
-        with open(record_path, "w") as f:
-            json.dump(record, f, indent=1)
-
-    # ---- fixture (the deterministic reference-scale witness) ----
-    args = default_sync_step_args(spec)
-    log(f"fixture ready ({spec.sync_committee_size} pubkeys, signed)")
-
-    # ---- stage 1: inner snark (Poseidon transcript) ----
-    srs = SRS.load_or_setup(k)
-    log(f"srs k={k}")
-    t = time.time()
-    pk = StepCircuit.create_pk(srs, spec, k, args)
-    record.setdefault("keygen_s", round(time.time() - t, 1))
-    cfg = pk.vk.config
-    log(f"step pk ready: advice={cfg.num_advice} lookup={cfg.num_lookup_advice} "
-        f"tables={cfg.lookup_tables} fixed={cfg.num_fixed}")
-    record["step_config"] = {
-        "num_advice": cfg.num_advice,
-        "num_lookup_advice": cfg.num_lookup_advice,
-        "lookup_bits": cfg.lookup_bits, "num_sha_slots": cfg.num_sha_slots}
-    save_record()
-
-    proof_path = os.path.join(BUILD_DIR, f"step_{spec.name}_{k}_poseidon.proof")
-    inst = StepCircuit.get_instances(args, spec)
-    if os.path.exists(proof_path):
-        with open(proof_path, "rb") as f:
-            proof = f.read()
-        log(f"stage-1 proof loaded from cache ({len(proof)} bytes)")
-    else:
-        t = time.time()
-        proof = StepCircuit.prove(pk, srs, args, spec,
-                                  transcript=PoseidonTranscript())
-        record["stage1_prove_s"] = round(time.time() - t, 1)
-        with open(proof_path, "wb") as f:
-            f.write(proof)
-        log(f"STAGE-1 PROOF: {len(proof)} bytes in {record['stage1_prove_s']}s")
-    record["stage1_proof_bytes"] = len(proof)
-    t = time.time()
-    # verify with the same transcript the proof was produced with
-    from spectre_tpu.plonk.verifier import verify as plonk_verify
-    ok = plonk_verify(pk.vk, srs, [inst], proof,
-                      transcript_cls=PoseidonTranscript)
-    assert ok, "stage-1 proof does not verify"
-    record["stage1_verify_s"] = round(time.time() - t, 1)
-    log(f"stage-1 verifies ({record['stage1_verify_s']}s)")
-    save_record()
-    if opts.stop_after == "inner":
-        return
-
-    # ---- stage 2: aggregation ----
-    agg_cls = AggregationCircuit.variant(StepCircuit.name)
-    agg_args = AggregationArgs(inner_vk=pk.vk, srs=srs,
-                               inner_instances=[inst], proof=proof)
-    t = time.time()
-    ctx = agg_cls.build_context(agg_args, spec)
-    st = ctx.stats()
-    record["agg_build_s"] = round(time.time() - t, 1)
-    record["agg_advice_cells"] = st["advice_cells"]
-    record["agg_lookup_cells"] = sum(st["lookup_cells"].values())
-    log(f"agg circuit built in {record['agg_build_s']}s: "
-        f"{st['advice_cells']:,} advice cells, "
-        f"{record['agg_lookup_cells']:,} lookup cells")
-    save_record()
-    assert st["advice_cells"] <= opts.max_agg_cells, \
-        f"aggregation circuit too large ({st['advice_cells']:,} cells)"
-
-    if opts.k_agg == "auto":
-        # smallest k whose column count stays in the reference's envelope
-        # (their verifier pins K=23 with 1 advice column at lookup 19)
-        cagg = None
-        for k_agg in range(20, 25):
-            cagg = ctx.auto_config(k=k_agg,
-                                   lookup_bits=agg_cls.default_lookup_bits)
-            if cagg.num_advice <= 12:
-                break
-        assert cagg is not None and cagg.num_advice <= 12, \
-            f"no k in 20..24 reaches <=12 advice (k=24: {cagg.num_advice})"
-    else:
-        k_agg = int(opts.k_agg)
-        cagg = ctx.auto_config(k=k_agg,
-                               lookup_bits=agg_cls.default_lookup_bits)
-    record["k_agg"] = k_agg
-    record["agg_config"] = {"num_advice": cagg.num_advice,
-                            "num_lookup_advice": cagg.num_lookup_advice}
-    log(f"agg k={k_agg}: advice={cagg.num_advice} "
-        f"lookup={cagg.num_lookup_advice}")
-    save_record()
-    if opts.stop_after == "agg-build":
-        return
-
-    srs_agg = SRS.load_or_setup(k_agg)
-    log(f"srs k={k_agg}")
-    t = time.time()
-    agg_pk = agg_cls.create_pk(srs_agg, spec, k_agg, agg_args)
-    record.setdefault("agg_keygen_s", round(time.time() - t, 1))
-    log("agg pk ready")
-    save_record()
-
-    oproof_path = os.path.join(
-        BUILD_DIR, f"agg_step_{spec.name}_{k_agg}_keccak.proof")
-    if os.path.exists(oproof_path):
-        with open(oproof_path, "rb") as f:
-            oproof = f.read()
-        with open(oproof_path + ".instances.json") as f:
-            stmt = [int(v, 16) for v in json.load(f)["instances"]]
-        log(f"stage-2 proof loaded from cache ({len(oproof)} bytes)")
-    else:
-        stmt = AggregationCircuit.get_instances(agg_args, spec)
-        t = time.time()
-        oproof = agg_cls.prove(agg_pk, srs_agg, agg_args, spec,
-                               transcript=KeccakTranscript())
-        record["stage2_prove_s"] = round(time.time() - t, 1)
-        with open(oproof_path, "wb") as f:
-            f.write(oproof)
-        with open(oproof_path + ".instances.json", "w") as f:
-            json.dump({"instances": [hex(v) for v in stmt]}, f)
-        log(f"STAGE-2 PROOF: {len(oproof)} bytes in {record['stage2_prove_s']}s")
-    record["stage2_proof_bytes"] = len(oproof)
-    t = time.time()
-    ok = agg_cls.verify(agg_pk.vk, srs_agg, stmt, oproof,
-                        transcript_cls=KeccakTranscript)
-    assert ok, "outer proof (incl. deferred pairing) does not verify"
-    record["stage2_verify_s"] = round(time.time() - t, 1)
-    log(f"stage-2 verifies incl. deferred KZG pairing "
-        f"({record['stage2_verify_s']}s)")
-    save_record()
-
-    # ---- EVM artifact: calldata + generated verifier executes ----
-    from spectre_tpu.evm import encode_calldata, gen_evm_verifier
-    from spectre_tpu.evm.simulator import run_verifier
-    calldata = encode_calldata(stmt, oproof)
-    record["calldata_bytes"] = len(calldata)
-    t = time.time()
-    sol = gen_evm_verifier(agg_pk.vk, srs_agg, num_instances=len(stmt),
-                           contract_name="Verifier_aggregation_sync_step",
-                           num_acc_limbs=12)
-    sol_path = os.path.join(
-        BUILD_DIR, f"aggregation_sync_step_{spec.name}_{k_agg}_verifier.sol")
-    with open(sol_path, "w") as f:
-        f.write(sol)
-    record["verifier_sol_bytes"] = len(sol)
-    log(f"EVM verifier generated: {len(sol)} bytes source")
-    ok = run_verifier(sol, stmt, oproof)
-    assert ok, "generated Solidity verifier rejected the outer proof"
-    bad = bytearray(oproof)
-    bad[37] ^= 1
-    assert not run_verifier(sol, stmt, bytes(bad)), \
-        "generated verifier accepted a tampered proof"
-    record["evm_verifier_s"] = round(time.time() - t, 1)
-    record["evm_verifier_ok"] = True
-    # static gas + deployed-size model (reference prints these from revm,
-    # `prover/src/cli.rs:249-277`; offline equivalent — evm/gas.py)
-    from spectre_tpu.evm import estimate_deployed_size, estimate_gas
-    g = estimate_gas(sol, calldata=calldata)
-    sz = estimate_deployed_size(sol)
-    record["gas_estimate"] = {k: v for k, v in g.items() if k != "counts"}
-    record["deployed_size_estimate"] = sz
-    log(f"gas estimate: {g.get('gas_total', g['gas_execution']):,} "
-        f"(execution {g['gas_execution']:,}); deployed size ~"
-        f"{sz['deployed_bytes_estimate']:,} B [{sz['deployed_size_risk']}]")
-    save_record()
-    log(f"DONE: record at {record_path}")
-    print(json.dumps(record, indent=1))
+    run_compressed_flow(
+        StepCircuit, default_sync_step_args,
+        spec=spec, k=k, k_agg=opts.k_agg,
+        # smallest outer k whose column count stays in the reference's
+        # envelope (their verifier pins K=23 with 1 advice at lookup 19)
+        k_agg_range=(20, 25),
+        max_agg_cells=opts.max_agg_cells,
+        max_agg_advice=opts.max_agg_advice,
+        record_name=f"compressed_{spec.name}_{k}.json",
+        inner_proof_name=f"step_{spec.name}_{k}_poseidon.proof",
+        outer_proof_name=f"agg_step_{spec.name}_{{k_agg}}_keccak.proof",
+        verifier_name=(f"aggregation_sync_step_{spec.name}"
+                       "_{k_agg}_verifier.sol"),
+        contract_name="Verifier_aggregation_sync_step",
+        stop_after=opts.stop_after,
+        tamper_byte=37)
 
 
 if __name__ == "__main__":
