@@ -19,11 +19,18 @@ SPECS = {"tiny": TINY, "minimal": MINIMAL, "testnet": TESTNET}
 
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "tiny"
+    # optional lookup_bits override: the RANGE-CHECK DECOMPOSITION inside
+    # the circuit depends on it, so the context must be rebuilt per value
+    # (this is how the lb=16/18 shapes were measured; reference pins lb=20
+    # at k=21, `config/sync_step_testnet.json`)
+    if len(sys.argv) > 2:
+        StepCircuit.default_lookup_bits = int(sys.argv[2])
     spec = SPECS[which]
     args = default_sync_step_args(spec)
     t0 = time.time()
     ctx = StepCircuit.build_context(args, spec)
     dt = time.time() - t0
+    print(f"lookup_bits={StepCircuit.default_lookup_bits}")
     st = ctx.stats()
     print(f"spec={which} build={dt:.1f}s")
     print(f"advice_cells={st['advice_cells']:,}")
