@@ -1,0 +1,450 @@
+"""A real EVM: bytecode interpreter with mainnet gas metering + precompiles.
+
+Reference parity: the reference executes its generated Yul verifier inside
+revm (`prover/src/cli.rs:249-277`, SURVEY.md N11) to report gas and code
+size. This module is that executor for the offline TPU environment: a
+stack-machine EVM sufficient for the verifier contracts this repo's own
+compiler (`evm/solc.py`) emits — executed from BYTECODE, with the
+post-Berlin/London gas schedule (EIP-150/1108/2028/2565/2929) metered per
+opcode, real memory-expansion costs, and the BN254/keccak/modexp
+precompiles backed by `fields/bn254`.
+
+Scope: the opcode subset a -- compiled verifier uses (no storage, no
+CREATE/CALL family beyond STATICCALL, no logs). Unknown opcodes raise —
+execution of arbitrary mainnet contracts is a non-goal; metering realism on
+OUR contracts is the goal.
+
+Gas notes:
+- precompile addresses are warm by definition (EIP-2929) — STATICCALL to
+  them costs 100 base + the precompile's own price;
+- memory expansion: 3w + floor(w^2/512) charged on the high-water word;
+- the 63/64 rule applies to the gas forwarded by STATICCALL;
+- intrinsic transaction gas (21000 + calldata bytes) is accounted by
+  `tx_intrinsic_gas` so callers can report an end-to-end number.
+"""
+
+from __future__ import annotations
+
+from ..fields import bn254
+from ..plonk.transcript import keccak256
+
+R = bn254.R
+Q = bn254.P
+U256 = (1 << 256) - 1
+
+
+class EvmError(Exception):
+    """Abnormal halt (invalid op, stack underflow, bad jump, OOG)."""
+
+
+class _Frame:
+    __slots__ = ("stack", "mem", "gas", "code", "pc", "calldata",
+                 "returndata", "jumpdests", "mem_words")
+
+    def __init__(self, code: bytes, calldata: bytes, gas: int):
+        self.code = code
+        self.calldata = calldata
+        self.gas = gas
+        self.stack: list[int] = []
+        self.mem = bytearray()
+        self.mem_words = 0
+        self.pc = 0
+        self.returndata = b""
+        self.jumpdests = _jumpdests(code)
+
+
+def _jumpdests(code: bytes) -> set:
+    dests = set()
+    i = 0
+    while i < len(code):
+        op = code[i]
+        if op == 0x5B:
+            dests.add(i)
+        if 0x60 <= op <= 0x7F:
+            i += op - 0x5F
+        i += 1
+    return dests
+
+
+# ---- gas schedule (post-London mainnet) ----
+G_VERYLOW, G_LOW, G_MID, G_HIGH = 3, 5, 8, 10
+G_BASE, G_JUMPDEST, G_SHA3, G_SHA3WORD, G_COPY = 2, 1, 30, 6, 3
+G_WARMACCESS = 100
+
+_GAS = {}
+for _op in (0x01, 0x03, 0x10, 0x11, 0x12, 0x13, 0x14, 0x15, 0x16, 0x17,
+            0x18, 0x19, 0x1A, 0x1B, 0x1C, 0x1D, 0x35, 0x51, 0x52, 0x53):
+    _GAS[_op] = G_VERYLOW          # add/sub/cmp/bit/shift/calldataload/mem
+for _op in (0x02, 0x04, 0x05, 0x06, 0x07, 0x0B):
+    _GAS[_op] = G_LOW              # mul/div/mod/signextend
+for _op in (0x08, 0x09, 0x56):
+    _GAS[_op] = G_MID              # addmod/mulmod/jump
+_GAS[0x57] = G_HIGH                # jumpi
+for _op in (0x30, 0x32, 0x33, 0x34, 0x36, 0x38, 0x3A, 0x3D, 0x41, 0x42,
+            0x43, 0x44, 0x45, 0x46, 0x48, 0x50, 0x58, 0x59, 0x5A):
+    _GAS[_op] = G_BASE
+_GAS[0x5B] = G_JUMPDEST
+_GAS[0x5F] = G_BASE                # PUSH0
+
+
+def _mem_gas(words: int) -> int:
+    return 3 * words + words * words // 512
+
+
+def _charge(fr: _Frame, amount: int):
+    fr.gas -= amount
+    if fr.gas < 0:
+        raise EvmError("out of gas")
+
+
+def _expand(fr: _Frame, offset: int, size: int):
+    """Charge memory expansion and grow the backing buffer."""
+    if size == 0:
+        return
+    if offset + size > (1 << 32):
+        raise EvmError("memory offset too large")
+    words = (offset + size + 31) // 32
+    if words > fr.mem_words:
+        _charge(fr, _mem_gas(words) - _mem_gas(fr.mem_words))
+        fr.mem_words = words
+    need = words * 32
+    if len(fr.mem) < need:
+        fr.mem.extend(b"\x00" * (need - len(fr.mem)))
+
+
+def _g2(words):
+    # precompile ordering: (x_c1, x_c0, y_c1, y_c0)
+    return (bn254.Fq2([int(words[1]), int(words[0])]),
+            bn254.Fq2([int(words[3]), int(words[2])]))
+
+
+def _modexp_gas(bsize: int, esize: int, msize: int, ehead: int) -> int:
+    """EIP-2565."""
+    words = (max(bsize, msize) + 7) // 8
+    mult = words * words
+    if esize <= 32:
+        iters = max(ehead.bit_length() - 1, 0)
+    else:
+        iters = 8 * (esize - 32) + max(ehead.bit_length() - 1, 0)
+    iters = max(iters, 1)
+    return max(200, mult * iters // 3)
+
+
+def _precompile(addr: int, data: bytes, gas: int):
+    """Returns (ok, returndata, gas_used); ok=False consumes all gas."""
+    g1 = bn254.g1_curve
+
+    def word(i):
+        return int.from_bytes(data[32 * i:32 * i + 32].ljust(32, b"\x00"),
+                              "big")
+
+    def to_pt(x, y):
+        if x == 0 and y == 0:
+            return None
+        if x >= Q or y >= Q:
+            raise ValueError("coordinate out of range")
+        pt = (bn254.Fq(x), bn254.Fq(y))
+        if not g1.is_on_curve(pt):
+            raise ValueError("not on curve")
+        return pt
+
+    def from_pt(pt):
+        if pt is None:
+            return b"\x00" * 64
+        return int(pt[0]).to_bytes(32, "big") + int(pt[1]).to_bytes(32, "big")
+
+    if addr == 0x05:               # modexp (EIP-2565)
+        bsize, esize, msize = word(0), word(1), word(2)
+        if max(bsize, esize, msize) > 1024:
+            return False, b"", gas
+        body = data[96:].ljust(bsize + esize + msize, b"\x00")
+        ehead = int.from_bytes(body[bsize:bsize + min(esize, 32)], "big")
+        cost = _modexp_gas(bsize, esize, msize, ehead)
+        if cost > gas:
+            return False, b"", gas
+        b = int.from_bytes(body[:bsize], "big")
+        e = int.from_bytes(body[bsize:bsize + esize], "big")
+        m = int.from_bytes(body[bsize + esize:bsize + esize + msize], "big")
+        out = (pow(b, e, m) if m else 0).to_bytes(msize, "big") if msize \
+            else b""
+        return True, out, cost
+    if addr == 0x06:               # bn254 ecAdd (EIP-1108: 150)
+        if gas < 150:
+            return False, b"", gas
+        try:
+            p = to_pt(word(0), word(1))
+            q2 = to_pt(word(2), word(3))
+        except ValueError:
+            return False, b"", gas
+        return True, from_pt(g1.add(p, q2)), 150
+    if addr == 0x07:               # bn254 ecMul (EIP-1108: 6000)
+        if gas < 6000:
+            return False, b"", gas
+        try:
+            p = to_pt(word(0), word(1))
+        except ValueError:
+            return False, b"", gas
+        return True, from_pt(g1.mul_unsafe(p, word(2) % R)), 6000
+    if addr == 0x08:               # bn254 pairing (EIP-1108)
+        if len(data) % 192:
+            return False, b"", gas
+        k = len(data) // 192
+        cost = 45000 + 34000 * k
+        if cost > gas:
+            return False, b"", gas
+        pairs = []
+        for i in range(k):
+            w = [word(6 * i + j) for j in range(6)]
+            try:
+                p = to_pt(w[0], w[1])
+            except ValueError:
+                return False, b"", gas
+            if any(v >= Q for v in w[2:]):
+                return False, b"", gas
+            g2pt = _g2(w[2:]) if any(w[2:]) else None
+            if g2pt is not None:
+                g2c = bn254.g2_curve
+                if not g2c.is_on_curve(g2pt):
+                    return False, b"", gas
+                # EIP-197 requires order-r subgroup membership for G2
+                if g2c.mul_unsafe(g2pt, R) is not None:
+                    return False, b"", gas
+            if p is None or g2pt is None:
+                continue           # infinity factors contribute 1
+            pairs.append((p, g2pt))
+        ok = bn254.pairing_check(pairs) if pairs else True
+        return True, (1 if ok else 0).to_bytes(32, "big"), cost
+    raise EvmError(f"unsupported precompile 0x{addr:x}")
+
+
+def execute(code: bytes, calldata: bytes, gas: int = 30_000_000):
+    """Run `code` as a message call. Returns (success, returndata, gas_used).
+
+    success=False covers both REVERT (returndata = revert payload) and
+    abnormal halts (returndata = b"", all gas consumed)."""
+    fr = _Frame(code, calldata, gas)
+    try:
+        out = _run(fr)
+        return True, out, gas - fr.gas
+    except _Revert as rv:
+        return False, rv.data, gas - fr.gas
+    except EvmError:
+        return False, b"", gas
+
+
+class _Revert(Exception):
+    def __init__(self, data: bytes):
+        self.data = data
+
+
+class _Return(Exception):
+    def __init__(self, data: bytes):
+        self.data = data
+
+
+def _run(fr: _Frame) -> bytes:
+    code = fr.code
+    stack = fr.stack
+    try:
+        while fr.pc < len(code):
+            op = code[fr.pc]
+            fr.pc += 1
+            base = _GAS.get(op)
+            if base is not None:
+                _charge(fr, base)
+            if 0x60 <= op <= 0x7F:             # PUSH1..PUSH32
+                n = op - 0x5F
+                _charge(fr, G_VERYLOW)
+                stack.append(
+                    int.from_bytes(code[fr.pc:fr.pc + n].ljust(n, b"\x00"),
+                                   "big"))
+                fr.pc += n
+            elif 0x80 <= op <= 0x8F:           # DUP1..DUP16
+                _charge(fr, G_VERYLOW)
+                stack.append(stack[-(op - 0x7F)])
+            elif 0x90 <= op <= 0x9F:           # SWAP1..SWAP16
+                _charge(fr, G_VERYLOW)
+                n = op - 0x8F
+                stack[-1], stack[-n - 1] = stack[-n - 1], stack[-1]
+            elif op == 0x5F:                   # PUSH0
+                stack.append(0)
+            elif op == 0x01:                   # ADD
+                stack.append((stack.pop() + stack.pop()) & U256)
+            elif op == 0x02:                   # MUL
+                stack.append((stack.pop() * stack.pop()) & U256)
+            elif op == 0x03:                   # SUB
+                a = stack.pop()
+                stack.append((a - stack.pop()) & U256)
+            elif op == 0x04:                   # DIV
+                a, b = stack.pop(), stack.pop()
+                stack.append(a // b if b else 0)
+            elif op == 0x06:                   # MOD
+                a, b = stack.pop(), stack.pop()
+                stack.append(a % b if b else 0)
+            elif op == 0x08:                   # ADDMOD
+                a, b, m = stack.pop(), stack.pop(), stack.pop()
+                stack.append((a + b) % m if m else 0)
+            elif op == 0x09:                   # MULMOD
+                a, b, m = stack.pop(), stack.pop(), stack.pop()
+                stack.append((a * b) % m if m else 0)
+            elif op == 0x0A:                   # EXP
+                a, e = stack.pop(), stack.pop()
+                _charge(fr, 10 + 50 * ((e.bit_length() + 7) // 8))
+                stack.append(pow(a, e, 1 << 256))
+            elif op == 0x10:                   # LT
+                a, b = stack.pop(), stack.pop()
+                stack.append(1 if a < b else 0)
+            elif op == 0x11:                   # GT
+                a, b = stack.pop(), stack.pop()
+                stack.append(1 if a > b else 0)
+            elif op == 0x14:                   # EQ
+                stack.append(1 if stack.pop() == stack.pop() else 0)
+            elif op == 0x15:                   # ISZERO
+                stack.append(1 if stack.pop() == 0 else 0)
+            elif op == 0x16:                   # AND
+                stack.append(stack.pop() & stack.pop())
+            elif op == 0x17:                   # OR
+                stack.append(stack.pop() | stack.pop())
+            elif op == 0x18:                   # XOR
+                stack.append(stack.pop() ^ stack.pop())
+            elif op == 0x19:                   # NOT
+                stack.append(stack.pop() ^ U256)
+            elif op == 0x1A:                   # BYTE
+                i, x = stack.pop(), stack.pop()
+                stack.append((x >> (8 * (31 - i))) & 0xFF if i < 32 else 0)
+            elif op == 0x1B:                   # SHL
+                s, v = stack.pop(), stack.pop()
+                stack.append((v << s) & U256 if s < 256 else 0)
+            elif op == 0x1C:                   # SHR
+                s, v = stack.pop(), stack.pop()
+                stack.append(v >> s if s < 256 else 0)
+            elif op == 0x20:                   # SHA3
+                off, size = stack.pop(), stack.pop()
+                _charge(fr, G_SHA3 + G_SHA3WORD * ((size + 31) // 32))
+                _expand(fr, off, size)
+                stack.append(int.from_bytes(
+                    keccak256(bytes(fr.mem[off:off + size])), "big"))
+            elif op == 0x34:                   # CALLVALUE (always 0 here)
+                stack.append(0)
+            elif op == 0x35:                   # CALLDATALOAD
+                off = stack.pop()
+                stack.append(int.from_bytes(
+                    fr.calldata[off:off + 32].ljust(32, b"\x00"), "big"))
+            elif op == 0x36:                   # CALLDATASIZE
+                stack.append(len(fr.calldata))
+            elif op == 0x37:                   # CALLDATACOPY
+                dst, src, size = stack.pop(), stack.pop(), stack.pop()
+                _charge(fr, G_VERYLOW + G_COPY * ((size + 31) // 32))
+                _expand(fr, dst, size)
+                fr.mem[dst:dst + size] = \
+                    fr.calldata[src:src + size].ljust(size, b"\x00")
+            elif op == 0x38:                   # CODESIZE
+                stack.append(len(code))
+            elif op == 0x39:                   # CODECOPY
+                dst, src, size = stack.pop(), stack.pop(), stack.pop()
+                _charge(fr, G_VERYLOW + G_COPY * ((size + 31) // 32))
+                _expand(fr, dst, size)
+                fr.mem[dst:dst + size] = code[src:src + size].ljust(
+                    size, b"\x00")
+            elif op == 0x3D:                   # RETURNDATASIZE
+                stack.append(len(fr.returndata))
+            elif op == 0x3E:                   # RETURNDATACOPY
+                dst, src, size = stack.pop(), stack.pop(), stack.pop()
+                _charge(fr, G_VERYLOW + G_COPY * ((size + 31) // 32))
+                if src + size > len(fr.returndata):
+                    raise EvmError("returndatacopy out of bounds")
+                _expand(fr, dst, size)
+                fr.mem[dst:dst + size] = fr.returndata[src:src + size]
+            elif op == 0x50:                   # POP
+                stack.pop()
+            elif op == 0x51:                   # MLOAD
+                off = stack.pop()
+                _expand(fr, off, 32)
+                stack.append(int.from_bytes(fr.mem[off:off + 32], "big"))
+            elif op == 0x52:                   # MSTORE
+                off, val = stack.pop(), stack.pop()
+                _expand(fr, off, 32)
+                fr.mem[off:off + 32] = val.to_bytes(32, "big")
+            elif op == 0x53:                   # MSTORE8
+                off, val = stack.pop(), stack.pop()
+                _expand(fr, off, 1)
+                fr.mem[off] = val & 0xFF
+            elif op == 0x56:                   # JUMP
+                dst = stack.pop()
+                if dst not in fr.jumpdests:
+                    raise EvmError(f"bad jump dest {dst}")
+                fr.pc = dst
+            elif op == 0x57:                   # JUMPI
+                dst, cond = stack.pop(), stack.pop()
+                if cond:
+                    if dst not in fr.jumpdests:
+                        raise EvmError(f"bad jump dest {dst}")
+                    fr.pc = dst
+            elif op == 0x58:                   # PC
+                stack.append(fr.pc - 1)
+            elif op == 0x5A:                   # GAS
+                stack.append(fr.gas)
+            elif op == 0x5B:                   # JUMPDEST
+                pass
+            elif op == 0xFA:                   # STATICCALL
+                g, addr, aoff, asize, roff, rsize = (
+                    stack.pop(), stack.pop(), stack.pop(), stack.pop(),
+                    stack.pop(), stack.pop())
+                _charge(fr, G_WARMACCESS)      # precompiles are always warm
+                _expand(fr, aoff, asize)
+                _expand(fr, roff, rsize)
+                avail = fr.gas - fr.gas // 64
+                sub_gas = min(g, avail)
+                args = bytes(fr.mem[aoff:aoff + asize])
+                if not 1 <= addr <= 9:
+                    raise EvmError(f"STATICCALL to non-precompile {addr:#x}")
+                ok, out, used = _precompile(addr, args, sub_gas)
+                _charge(fr, used if ok else sub_gas)
+                fr.returndata = out
+                if ok:
+                    fr.mem[roff:roff + min(rsize, len(out))] = \
+                        out[:min(rsize, len(out))]
+                stack.append(1 if ok else 0)
+            elif op == 0xF3:                   # RETURN
+                off, size = stack.pop(), stack.pop()
+                _expand(fr, off, size)
+                raise _Return(bytes(fr.mem[off:off + size]))
+            elif op == 0xFD:                   # REVERT
+                off, size = stack.pop(), stack.pop()
+                _expand(fr, off, size)
+                raise _Revert(bytes(fr.mem[off:off + size]))
+            elif op == 0x00:                   # STOP
+                return b""
+            else:
+                raise EvmError(f"unsupported opcode 0x{op:02x} @ {fr.pc - 1}")
+        return b""
+    except _Return as r:
+        return r.data
+    except IndexError:
+        raise EvmError("stack underflow")
+
+
+def tx_intrinsic_gas(calldata: bytes) -> int:
+    """21000 + EIP-2028 calldata pricing."""
+    zeros = calldata.count(0)
+    return 21000 + 4 * zeros + 16 * (len(calldata) - zeros)
+
+
+def deploy(init_code: bytes, gas: int = 30_000_000):
+    """Run constructor code; returns (runtime_code, gas_used).
+
+    Charges the 200/byte code-deposit cost (EIP-170 enforced)."""
+    ok, runtime, used = execute(init_code, b"", gas)
+    if not ok:
+        raise EvmError("constructor reverted")
+    if len(runtime) > 24576:
+        raise EvmError(f"EIP-170: runtime code {len(runtime)} B > 24576 B")
+    return runtime, used + 200 * len(runtime)
+
+
+def revert_reason(returndata: bytes) -> str | None:
+    """Decode Error(string) revert payloads."""
+    if len(returndata) >= 68 and returndata[:4] == bytes.fromhex("08c379a0"):
+        ln = int.from_bytes(returndata[36:68], "big")
+        return returndata[68:68 + ln].decode("utf-8", "replace")
+    return None
