@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Production-size TPU kernel sweep (MSM + NTT + field throughput).
+
+bench.py's headline is MSM 2^16 — but the flagship prove's MSMs are
+2^21 (k=21 commitments) and its quotient NTTs are 2^21..2^23. TPU
+amortization improves with size (r1: NTT 2^20 was 3.9x CPU while MSM 2^16
+was ~1x), so the production-relevant comparison is the sweep, not the
+point. Runs each size on the ambient device AND the native C++ single-
+thread baseline, writes build/tpu_sweep.json.
+
+Usage: python scripts/tpu_sweep.py [--msm 16,18,20] [--ntt 20,22] [--quick]
+Every device phase is a subprocess with a deadline (tunnel-wedge-proof,
+same pattern as bench.py).
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "build", "tpu_sweep.json")
+T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time()-T0:7.1f}s] {msg}", flush=True)
+
+
+def child_msm(logn: int, c: int, out_path: str):
+    import jax
+    from spectre_tpu.plonk.backend import setup_compile_cache
+    setup_compile_cache()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spectre_tpu.ops import field_ops as F, limbs as L, msm as MSM
+    sys.path.insert(0, os.path.join(REPO))
+    from bench import bench_inputs
+
+    pts64, sc64 = bench_inputs(logn)
+    ctxq = F.fq_ctx()
+    x16 = L.u64limbs_to_u16limbs(pts64[:, :4])
+    y16 = L.u64limbs_to_u16limbs(pts64[:, 4:])
+    to_mont = jax.jit(lambda v: F.to_mont(ctxq, v))
+    xm, ym = to_mont(jnp.asarray(x16)), to_mont(jnp.asarray(y16))
+    one = jnp.broadcast_to(jnp.asarray(ctxq.one_mont),
+                           (1 << logn, F.NLIMBS))
+    pts = jnp.stack([xm, ym, one], axis=1)
+    sc16 = jnp.asarray(L.u64limbs_to_u16limbs(sc64))
+
+    def run():
+        return np.asarray(
+            MSM.combine_windows(MSM.msm_windows(pts, sc16, c), c))
+
+    run()                      # compile + warm
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        run()
+        dt = min(dt, time.time() - t0)
+    with open(out_path, "w") as f:
+        json.dump({"seconds": dt, "points_per_s": (1 << logn) / dt,
+                   "backend": jax.default_backend(), "c": c}, f)
+
+
+def child_ntt(logn: int, out_path: str):
+    import jax
+    from spectre_tpu.plonk.backend import setup_compile_cache
+    setup_compile_cache()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spectre_tpu.fields import bn254 as bn
+    from spectre_tpu.ops import field_ops as F, ntt as NTT
+    from spectre_tpu.plonk.domain import Domain
+
+    omega = Domain(logn).omega
+    fctx = F.fr_ctx()
+    vals = [(i * 2654435761 + 17) % bn.R for i in range(1 << logn)]
+    arr = jnp.asarray(fctx.encode_np(vals))
+
+    def run():
+        return np.asarray(NTT.ntt(arr, omega))
+
+    run()
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        run()
+        dt = min(dt, time.time() - t0)
+    with open(out_path, "w") as f:
+        json.dump({"seconds": dt, "backend": jax.default_backend()}, f)
+
+
+def child_mont(logn: int, out_path: str):
+    import jax
+    from spectre_tpu.plonk.backend import setup_compile_cache
+    setup_compile_cache()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spectre_tpu.fields import bn254 as bn
+    from spectre_tpu.ops import field_ops as F
+
+    n = 1 << logn
+    ctx = F.fq_ctx()
+    a = [(i * 48271 + 11) % bn.P for i in range(n)]
+    b = [(i * 69621 + 7) % bn.P for i in range(n)]
+    am = jnp.asarray(ctx.encode_np(a))
+    bm = jnp.asarray(ctx.encode_np(b))
+    mul = jax.jit(lambda x, y: F.mont_mul(ctx, x, y))
+
+    def run():
+        return np.asarray(mul(am, bm))
+
+    run()
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        run()
+        dt = min(dt, time.time() - t0)
+    with open(out_path, "w") as f:
+        json.dump({"seconds": dt, "muls_per_s": n / dt,
+                   "backend": jax.default_backend()}, f)
+
+
+def run_child(kind: str, timeout: float, **kw):
+    import tempfile
+    fd, out = tempfile.mkstemp(suffix=".json")
+    os.close(fd)
+    env = dict(os.environ, SWEEP_CHILD=kind, SWEEP_OUT=out,
+               SWEEP_KW=json.dumps(kw))
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, cwd=REPO, timeout=timeout,
+                           capture_output=True, text=True)
+        if r.returncode == 0 and os.path.getsize(out):
+            with open(out) as f:
+                return json.load(f)
+        return {"error": (r.stderr or "")[-400:]}
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout}s"}
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+
+
+def native_msm_baseline(logn: int) -> float:
+    from bench import bench_inputs
+    from spectre_tpu.native import host
+    pts64, sc64 = bench_inputs(logn)
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        host.g1_msm(pts64, sc64)
+        dt = min(dt, time.time() - t0)
+    return dt
+
+
+def native_ntt_baseline(logn: int) -> float:
+    from spectre_tpu.fields import bn254 as bn
+    from spectre_tpu.native import host
+    from spectre_tpu.plonk.domain import Domain
+    omega = Domain(logn).omega
+    vals = host.ints_to_limbs([(i * 2654435761 + 17) % bn.R
+                               for i in range(1 << logn)])
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        host.fr_ntt(vals, omega)     # in place; timing unaffected by content
+        dt = min(dt, time.time() - t0)
+    return dt
+
+
+def main():
+    kind = os.environ.get("SWEEP_CHILD")
+    if kind:
+        kw = json.loads(os.environ["SWEEP_KW"])
+        {"msm": child_msm, "ntt": child_ntt,
+         "mont": child_mont}[kind](out_path=os.environ["SWEEP_OUT"], **kw)
+        return
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--msm", default="16,18,20")
+    ap.add_argument("--ntt", default="20,22")
+    ap.add_argument("--mont", default="20")
+    ap.add_argument("--quick", action="store_true")
+    opts = ap.parse_args()
+    if opts.quick:
+        opts.msm, opts.ntt, opts.mont = "16", "20", "20"
+
+    res = {"started_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "msm": {}, "ntt": {}, "mont": {}}
+
+    def save():
+        with open(OUT, "w") as f:
+            json.dump(res, f, indent=1)
+
+    for logn in [int(v) for v in opts.msm.split(",") if v]:
+        c = 13 if logn >= 18 else 10
+        dev = run_child("msm", timeout=1800, logn=logn, c=c)
+        log(f"msm 2^{logn} device: {dev}")
+        cpu_dt = native_msm_baseline(logn)
+        entry = {"device": dev, "cpu_native_s": round(cpu_dt, 3)}
+        if "seconds" in dev:
+            entry["speedup_vs_1core"] = round(cpu_dt / dev["seconds"], 2)
+        res["msm"][f"2^{logn}"] = entry
+        save()
+        log(f"msm 2^{logn}: cpu {cpu_dt:.2f}s; {entry.get('speedup_vs_1core')}x")
+
+    for logn in [int(v) for v in opts.ntt.split(",") if v]:
+        dev = run_child("ntt", timeout=1800, logn=logn)
+        log(f"ntt 2^{logn} device: {dev}")
+        cpu_dt = native_ntt_baseline(logn)
+        entry = {"device": dev, "cpu_native_s": round(cpu_dt, 3)}
+        if "seconds" in dev:
+            entry["speedup_vs_1core"] = round(cpu_dt / dev["seconds"], 2)
+        res["ntt"][f"2^{logn}"] = entry
+        save()
+        log(f"ntt 2^{logn}: cpu {cpu_dt:.2f}s; {entry.get('speedup_vs_1core')}x")
+
+    for logn in [int(v) for v in opts.mont.split(",") if v]:
+        dev = run_child("mont", timeout=1200, logn=logn)
+        res["mont"][f"2^{logn}"] = {"device": dev}
+        save()
+        log(f"mont 2^{logn}: {dev}")
+
+    res["finished_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    save()
+    log(f"DONE -> {OUT}")
+
+
+if __name__ == "__main__":
+    main()
