@@ -53,8 +53,10 @@ OPS = {
     "CALLDATASIZE": 0x36, "CALLDATACOPY": 0x37, "CODESIZE": 0x38,
     "CODECOPY": 0x39, "RETURNDATASIZE": 0x3D, "RETURNDATACOPY": 0x3E,
     "POP": 0x50, "MLOAD": 0x51, "MSTORE": 0x52, "MSTORE8": 0x53,
+    "SLOAD": 0x54, "SSTORE": 0x55,
     "JUMP": 0x56, "JUMPI": 0x57, "PC": 0x58, "GAS": 0x5A,
-    "JUMPDEST": 0x5B, "RETURN": 0xF3, "STATICCALL": 0xFA, "REVERT": 0xFD,
+    "JUMPDEST": 0x5B, "ADDRESS": 0x30, "CALLER": 0x33,
+    "CALL": 0xF1, "RETURN": 0xF3, "STATICCALL": 0xFA, "REVERT": 0xFD,
 }
 for _i in range(16):
     OPS[f"DUP{_i + 1}"] = 0x80 + _i
@@ -71,7 +73,7 @@ _TOKEN_RE = re.compile(
     r'|"(?P<str>[^"]*)"'
     r'|(?P<num>0x[0-9a-fA-F]+|\d+)'
     r'|(?P<id>[A-Za-z_$]\w*)'
-    r'|(?P<op><<|\+\+|\+=|==|!=|&&|[-+*!<>=(),;:\[\]{}.])')
+    r'|(?P<op><<|>>|\+\+|\+=|==|!=|&&|[-+*/!<>=&|(),;:\[\]{}.])')
 
 
 def _tokenize(s: str):
@@ -118,7 +120,10 @@ class _Parser:
         k, v = self.peek()
         return k == kind and (val is None or v == val)
 
-    # expression grammar: and > cmp > add > shift > unary > postfix > primary
+    # expression grammar (loosest to tightest): && > cmp > | > & > shift
+    # > additive > multiplicative > unary > postfix > primary. The emitted
+    # sources parenthesize every mixed-precedence site, so only relative
+    # order within each chain matters.
     def expr(self):
         e = self.cmp()
         while self.at("op", "&&"):
@@ -127,25 +132,46 @@ class _Parser:
         return e
 
     def cmp(self):
-        e = self.add()
+        e = self.bitor()
         while self.at("op", "<") or self.at("op", "==") or \
                 self.at("op", "!=") or self.at("op", ">"):
+            op = self.next()[1]
+            e = ("bin", op, e, self.bitor())
+        return e
+
+    def bitor(self):
+        e = self.bitand()
+        while self.at("op", "|"):
+            self.next()
+            e = ("bin", "|", e, self.bitand())
+        return e
+
+    def bitand(self):
+        e = self.shift()
+        while self.at("op", "&"):
+            self.next()
+            e = ("bin", "&", e, self.shift())
+        return e
+
+    def shift(self):
+        e = self.add()
+        while self.at("op", "<<") or self.at("op", ">>"):
             op = self.next()[1]
             e = ("bin", op, e, self.add())
         return e
 
     def add(self):
-        e = self.shift()
+        e = self.mult()
         while self.at("op", "+") or self.at("op", "-"):
             op = self.next()[1]
-            e = ("bin", op, e, self.shift())
+            e = ("bin", op, e, self.mult())
         return e
 
-    def shift(self):
+    def mult(self):
         e = self.unary()
-        while self.at("op", "<<"):
-            self.next()
-            e = ("bin", "<<", e, self.unary())
+        while self.at("op", "*") or self.at("op", "/"):
+            op = self.next()[1]
+            e = ("bin", op, e, self.unary())
         return e
 
     def unary(self):
@@ -177,8 +203,11 @@ class _Parser:
                     self.eat("op", "(")
                     args = self._args()
                     e = ("packed", args)
-                else:
-                    raise SyntaxError(f"unsupported member .{name}")
+                elif self.at("op", "("):      # method call: x.f(...)
+                    self.next()
+                    e = ("method", e, name, self._args())
+                else:                         # struct member: x.f
+                    e = ("member", e, name)
             else:
                 return e
 
@@ -360,15 +389,16 @@ class _Compiler:
     def eval_bin(self, e):
         _, op, l, r = e
         a = self.a
-        if op in ("+", "-"):
-            # EVM ADD/SUB pop (top, next) as (a, b) -> a op b
+        if op in ("+", "-", "*", "/", "&", "|"):
+            # EVM binops pop (top, next) as (a, b) -> a op b
             self.eval_scalar(r)
             self.eval_scalar(l)
-            a.op("ADD" if op == "+" else "SUB")
-        elif op == "<<":
+            a.op({"+": "ADD", "-": "SUB", "*": "MUL", "/": "DIV",
+                  "&": "AND", "|": "OR"}[op])
+        elif op in ("<<", ">>"):
             self.eval_scalar(l)          # value
             self.eval_scalar(r)          # shift (top)
-            a.op("SHL")
+            a.op("SHL" if op == "<<" else "SHR")
         elif op == "<":
             self.eval_scalar(r)
             self.eval_scalar(l)

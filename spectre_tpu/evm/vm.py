@@ -9,10 +9,14 @@ post-Berlin/London gas schedule (EIP-150/1108/2028/2565/2929) metered per
 opcode, real memory-expansion costs, and the BN254/keccak/modexp
 precompiles backed by `fields/bn254`.
 
-Scope: the opcode subset a -- compiled verifier uses (no storage, no
-CREATE/CALL family beyond STATICCALL, no logs). Unknown opcodes raise —
+Scope: the opcode subset the compiled verifier and protocol contracts use
+— storage (SLOAD/SSTORE with EIP-2929+2200 pricing and revert journaling),
+CALL/STATICCALL between World-deployed contracts and precompiles, but no
+CREATE family, no logs, no value transfers. Unknown opcodes raise —
 execution of arbitrary mainnet contracts is a non-goal; metering realism on
-OUR contracts is the goal.
+OUR contracts is the goal. (Known simplification: SSTORE refunds for
+clearing slots are tracked and capped per EIP-3529, but other refund
+sources are not modeled.)
 
 Gas notes:
 - precompile addresses are warm by definition (EIP-2929) — STATICCALL to
@@ -39,9 +43,12 @@ class EvmError(Exception):
 
 class _Frame:
     __slots__ = ("stack", "mem", "gas", "code", "pc", "calldata",
-                 "returndata", "jumpdests", "mem_words")
+                 "returndata", "jumpdests", "mem_words", "world", "address",
+                 "caller", "static")
 
-    def __init__(self, code: bytes, calldata: bytes, gas: int):
+    def __init__(self, code: bytes, calldata: bytes, gas: int, world=None,
+                 address: int = 0, caller: int = 0, static: bool = False,
+                 jumpdests: set | None = None):
         self.code = code
         self.calldata = calldata
         self.gas = gas
@@ -50,7 +57,11 @@ class _Frame:
         self.mem_words = 0
         self.pc = 0
         self.returndata = b""
-        self.jumpdests = _jumpdests(code)
+        self.jumpdests = _jumpdests(code) if jumpdests is None else jumpdests
+        self.world = world
+        self.address = address
+        self.caller = caller
+        self.static = static
 
 
 def _jumpdests(code: bytes) -> set:
@@ -133,6 +144,13 @@ def _modexp_gas(bsize: int, esize: int, msize: int, ehead: int) -> int:
 def _precompile(addr: int, data: bytes, gas: int):
     """Returns (ok, returndata, gas_used); ok=False consumes all gas."""
     g1 = bn254.g1_curve
+
+    if addr == 0x02:               # SHA-256
+        import hashlib
+        cost = 60 + 12 * ((len(data) + 31) // 32)
+        if cost > gas:
+            return False, b"", gas
+        return True, hashlib.sha256(data).digest(), cost
 
     def word(i):
         return int.from_bytes(data[32 * i:32 * i + 32].ljust(32, b"\x00"),
@@ -217,12 +235,15 @@ def _precompile(addr: int, data: bytes, gas: int):
     raise EvmError(f"unsupported precompile 0x{addr:x}")
 
 
-def execute(code: bytes, calldata: bytes, gas: int = 30_000_000):
+def execute(code: bytes, calldata: bytes, gas: int = 30_000_000,
+            world=None, address: int = 0, caller: int = 0,
+            static: bool = False):
     """Run `code` as a message call. Returns (success, returndata, gas_used).
 
     success=False covers both REVERT (returndata = revert payload) and
     abnormal halts (returndata = b"", all gas consumed)."""
-    fr = _Frame(code, calldata, gas)
+    fr = _Frame(code, calldata, gas, world=world, address=address,
+                caller=caller, static=static)
     try:
         out = _run(fr)
         return True, out, gas - fr.gas
@@ -386,25 +407,72 @@ def _run(fr: _Frame) -> bytes:
                 stack.append(fr.gas)
             elif op == 0x5B:                   # JUMPDEST
                 pass
-            elif op == 0xFA:                   # STATICCALL
-                g, addr, aoff, asize, roff, rsize = (
-                    stack.pop(), stack.pop(), stack.pop(), stack.pop(),
-                    stack.pop(), stack.pop())
-                _charge(fr, G_WARMACCESS)      # precompiles are always warm
+            elif op in (0xFA, 0xF1):           # STATICCALL / CALL
+                g, addr = stack.pop(), stack.pop()
+                value = stack.pop() if op == 0xF1 else 0
+                aoff, asize, roff, rsize = (stack.pop(), stack.pop(),
+                                            stack.pop(), stack.pop())
+                if value:
+                    raise EvmError("value transfers unsupported")
                 _expand(fr, aoff, asize)
                 _expand(fr, roff, rsize)
-                avail = fr.gas - fr.gas // 64
-                sub_gas = min(g, avail)
                 args = bytes(fr.mem[aoff:aoff + asize])
-                if not 1 <= addr <= 9:
-                    raise EvmError(f"STATICCALL to non-precompile {addr:#x}")
-                ok, out, used = _precompile(addr, args, sub_gas)
-                _charge(fr, used if ok else sub_gas)
+                if 1 <= addr <= 9:
+                    _charge(fr, G_WARMACCESS)  # precompiles are always warm
+                    avail = fr.gas - fr.gas // 64
+                    sub_gas = min(g, avail)
+                    ok, out, used = _precompile(addr, args, sub_gas)
+                    _charge(fr, used if ok else sub_gas)
+                elif fr.world is not None and addr in fr.world.contracts:
+                    _charge(fr, fr.world.touch_address(addr))
+                    avail = fr.gas - fr.gas // 64
+                    sub_gas = min(g, avail)
+                    ok, out, used = fr.world.message_call(
+                        addr, args, sub_gas, caller=fr.address,
+                        static=fr.static or op == 0xFA)
+                    _charge(fr, used)
+                else:
+                    raise EvmError(f"call to unknown account {addr:#x}")
                 fr.returndata = out
                 if ok:
                     fr.mem[roff:roff + min(rsize, len(out))] = \
                         out[:min(rsize, len(out))]
                 stack.append(1 if ok else 0)
+            elif op == 0x54:                   # SLOAD
+                if fr.world is None:
+                    raise EvmError("SLOAD without world state")
+                key = stack.pop()
+                _charge(fr, fr.world.touch_slot(fr.address, key))
+                stack.append(
+                    fr.world.contracts[fr.address].storage.get(key, 0))
+            elif op == 0x55:                   # SSTORE (EIP-2200/2929/3529)
+                if fr.world is None:
+                    raise EvmError("SSTORE without world state")
+                if fr.static:
+                    raise EvmError("SSTORE in static context")
+                key, val = stack.pop(), stack.pop()
+                w = fr.world
+                st = w.contracts[fr.address].storage
+                cold = w.touch_slot(fr.address, key, base_charge=False)
+                cur = st.get(key, 0)
+                orig = w.tx_original(fr.address, key, cur)
+                if val == cur:
+                    cost = 100
+                elif orig == cur:              # clean slot
+                    cost = 20000 if orig == 0 else 2900
+                    if orig != 0 and val == 0:
+                        w.refund += 4800
+                else:                          # dirty slot
+                    cost = 100
+                _charge(fr, cold + cost)
+                if val:
+                    st[key] = val
+                else:
+                    st.pop(key, None)
+            elif op == 0x30:                   # ADDRESS
+                stack.append(fr.address)
+            elif op == 0x33:                   # CALLER
+                stack.append(fr.caller)
             elif op == 0xF3:                   # RETURN
                 off, size = stack.pop(), stack.pop()
                 _expand(fr, off, size)
@@ -431,15 +499,13 @@ def tx_intrinsic_gas(calldata: bytes) -> int:
 
 
 def deploy(init_code: bytes, gas: int = 30_000_000):
-    """Run constructor code; returns (runtime_code, gas_used).
-
-    Charges the 200/byte code-deposit cost (EIP-170 enforced)."""
+    """Run standalone constructor code (no world state); returns
+    (runtime_code, gas_used) with the 200/byte deposit (EIP-170 enforced).
+    Storage-using constructors must deploy through World.deploy."""
     ok, runtime, used = execute(init_code, b"", gas)
     if not ok:
         raise EvmError("constructor reverted")
-    if len(runtime) > 24576:
-        raise EvmError(f"EIP-170: runtime code {len(runtime)} B > 24576 B")
-    return runtime, used + 200 * len(runtime)
+    return runtime, used + _enforce_code_deposit(runtime)
 
 
 def revert_reason(returndata: bytes) -> str | None:
@@ -448,3 +514,132 @@ def revert_reason(returndata: bytes) -> str | None:
         ln = int.from_bytes(returndata[36:68], "big")
         return returndata[68:68 + ln].decode("utf-8", "replace")
     return None
+
+
+class Contract:
+    __slots__ = ("code", "storage", "_jumpdests")
+
+    def __init__(self, code: bytes):
+        self.code = code
+        self.storage: dict[int, int] = {}
+        self._jumpdests = None
+
+    def jumpdests(self) -> set:
+        if self._jumpdests is None:
+            self._jumpdests = _jumpdests(self.code)
+        return self._jumpdests
+
+
+def _enforce_code_deposit(runtime: bytes) -> int:
+    """EIP-170 limit + EIP-3860-era 200/byte deposit gas."""
+    if len(runtime) > 24576:
+        raise EvmError(f"EIP-170: runtime code {len(runtime)} B > 24576 B")
+    return 200 * len(runtime)
+
+
+class World:
+    """Minimal multi-contract chain state: deployed code + storage, the
+    per-transaction EIP-2929 warm sets, EIP-2200 original-value tracking,
+    and revert journaling. The stand-in for the reference's anvil node in
+    contract tests (`contract-tests/tests/spectre.rs`)."""
+
+    def __init__(self):
+        self.contracts: dict[int, Contract] = {}
+        self._next_addr = 0x1000
+        self._warm_addrs: set[int] = set()
+        self._warm_slots: set[tuple[int, int]] = set()
+        self._tx_original: dict[tuple[int, int], int] = {}
+        self.refund = 0
+
+    # -- per-transaction accounting --
+    def begin_tx(self):
+        self._warm_addrs = set()
+        self._warm_slots = set()
+        self._tx_original = {}
+        self.refund = 0
+
+    def tx_original(self, addr: int, key: int, current: int) -> int:
+        """Value of the slot at transaction start (EIP-2200)."""
+        return self._tx_original.setdefault((addr, key), current)
+
+    def touch_address(self, addr: int) -> int:
+        if addr in self._warm_addrs:
+            return G_WARMACCESS
+        self._warm_addrs.add(addr)
+        return 2600
+
+    def touch_slot(self, addr: int, key: int,
+                   base_charge: bool = True) -> int:
+        """SLOAD price (base_charge=True): 2100 cold / 100 warm.
+        SSTORE cold surcharge (base_charge=False): 2100 cold / 0 warm."""
+        if (addr, key) in self._warm_slots:
+            return G_WARMACCESS if base_charge else 0
+        self._warm_slots.add((addr, key))
+        return 2100
+
+    # -- revert journaling: snapshot world-visible state per call frame --
+    def _snapshot(self):
+        return ({a: dict(c.storage) for a, c in self.contracts.items()},
+                set(self._warm_addrs), set(self._warm_slots),
+                dict(self._tx_original), self.refund)
+
+    def _restore(self, snap):
+        storages, warm_a, warm_s, orig, refund = snap
+        for a, st in storages.items():
+            self.contracts[a].storage = st
+        self._warm_addrs = warm_a
+        self._warm_slots = warm_s
+        self._tx_original = orig
+        self.refund = refund
+
+    def deploy(self, init_code: bytes, ctor_args: bytes = b"",
+               gas: int = 30_000_000) -> tuple[int, int]:
+        """Run constructor (args appended to init code, solc-style);
+        registers the returned runtime. Returns (address, gas_used)."""
+        addr = self._next_addr
+        self._next_addr += 1
+        self.contracts[addr] = Contract(b"")   # storage visible to ctor
+        self.begin_tx()
+        ok, runtime, used = execute(init_code + ctor_args, b"", gas,
+                                    world=self, address=addr)
+        if not ok:
+            del self.contracts[addr]
+            raise EvmError(f"constructor reverted: "
+                           f"{revert_reason(runtime) or runtime.hex()}")
+        self.contracts[addr].code = runtime
+        return addr, used + _enforce_code_deposit(runtime)
+
+    def transact(self, to: int, calldata: bytes, gas: int = 30_000_000,
+                 caller: int = 0xCA11E12):
+        """Top-level transaction. Returns (ok, returndata,
+        gas_incl_intrinsic); refunds applied per EIP-3529 (<= used/5)."""
+        self.begin_tx()
+        self._warm_addrs.add(to)
+        ok, out, used = self.message_call(to, calldata, gas, caller=caller)
+        if ok:
+            used -= min(self.refund, used // 5)
+        return ok, out, used + tx_intrinsic_gas(calldata)
+
+    def call_view(self, to: int, calldata: bytes, gas: int = 30_000_000):
+        """eth_call-style read; no intrinsic gas added."""
+        self.begin_tx()
+        self._warm_addrs.add(to)
+        return self.message_call(to, calldata, gas, caller=0, static=True)
+
+    def message_call(self, to: int, calldata: bytes, gas: int,
+                     caller: int = 0, static: bool = False):
+        """Nested message call with revert semantics: a failing frame's
+        storage writes and access-set additions are rolled back."""
+        c = self.contracts[to]
+        snap = self._snapshot()
+        fr = _Frame(c.code, calldata, gas, world=self, address=to,
+                    caller=caller, static=static, jumpdests=c.jumpdests())
+        try:
+            out = _run(fr)
+            return True, out, gas - fr.gas
+        except _Revert as rv:
+            self._restore(snap)
+            return False, rv.data, gas - fr.gas
+        except EvmError:
+            self._restore(snap)
+            return False, b"", gas
