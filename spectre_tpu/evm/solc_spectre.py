@@ -38,7 +38,8 @@ VARS_BASE = 0x100         # named locals / decoded params (assembler-fixed)
 
 _SELECTOR_TYPES = {"uint256": "uint256", "uint64": "uint64",
                    "bytes32": "bytes32", "bytes8": "bytes8",
-                   "address": "address", "bytes": "bytes", "bool": "bool"}
+                   "address": "address", "bytes": "bytes", "bool": "bool",
+                   "uint256[12]": "uint256[12]"}
 
 
 def _keccak(data: bytes) -> bytes:
@@ -81,6 +82,7 @@ class SpectreCompiler:
         self.ctor: _Fn | None = None
         self.var_types: dict[str, str] = {}    # local name -> type
         self.struct_bases: dict[str, int] = {}  # struct param -> cd offset
+        self.cd_arrays: dict[str, tuple] = {}  # fixed-array param -> (off, n)
         self.cur_fn: _Fn | None = None
         self._parse_contract()
 
@@ -128,12 +130,14 @@ class SpectreCompiler:
                         None, m.group(2).split("\n"))
         # functions
         for m in re.finditer(
-                r"function (\w+)\(([^)]*)\)\s*\n?\s*(?:external|public)"
+                r"function (\w+)\(([^)]*)\)\s*\n?\s*"
+                r"(external|public|internal)"
                 r"[^{]*?(?:returns \((\w+)\))?\s*\{(.*?)\n    \}", src,
                 re.DOTALL):
-            name, params, ret, body = m.groups()
+            name, params, vis, ret, body = m.groups()
             self.fns[name] = _Fn(name, self._parse_params(params), ret,
-                                 body.split("\n"))
+                                 body.split("\n"),
+                                 external=vis != "internal")
 
     @staticmethod
     def _parse_params(s: str):
@@ -291,11 +295,31 @@ class SpectreCompiler:
         a = self.a
         assert base[0] == "var"
         name = base[1]
-        if name in self.arrays:
+        if name in self.cd_arrays:             # fixed-size calldata array
+            off, n = self.cd_arrays[name]
+            if idx[0] == "num":
+                assert idx[1] < n
+                a.push(off + 32 * idx[1])
+            else:
+                self.eval(idx)
+                a.push(5)
+                a.op("SHL")
+                a.push(off)
+                a.op("ADD")
+            a.op("CALLDATALOAD")
+        elif name in self.arrays:
             lbl, n = self.arrays[name]
-            assert idx[0] == "num" and idx[1] < n
-            a.pushl(f"__arrays")
-            a.push(lbl + 32 * (idx[1] + 1))
+            if idx[0] == "num":
+                assert idx[1] < n
+                a.pushl("__arrays")
+                a.push(lbl + 32 * (idx[1] + 1))
+            else:
+                self.eval(idx)
+                a.push(5)
+                a.op("SHL")
+                a.pushl("__arrays")
+                a.push(lbl + 32)
+                a.op("ADD")          # [i*32, base+off]; shared ADD follows
             a.op("ADD", "MLOAD")
         elif name in self.storage_vars and \
                 self.storage_vars[name]["kind"] == "mapping":
@@ -597,11 +621,19 @@ class SpectreCompiler:
             val = _Parser(_tokenize(rhs)).expr()
             if name in self.arrays:
                 lbl, n = self.arrays[name]
-                idx = int(key_src)
-                assert idx < n
+                idx = _Parser(_tokenize(key_src)).expr()
                 self.eval(val)
-                a.pushl("__arrays")
-                a.push(lbl + 32 * (idx + 1))
+                if idx[0] == "num":
+                    assert idx[1] < n
+                    a.pushl("__arrays")
+                    a.push(lbl + 32 * (idx[1] + 1))
+                else:
+                    self.eval(idx)
+                    a.push(5)
+                    a.op("SHL")
+                    a.pushl("__arrays")
+                    a.push(lbl + 32)
+                    a.op("ADD")
                 a.op("ADD", "MSTORE")
             else:
                 sv = self.storage_vars[name]
@@ -624,6 +656,13 @@ class SpectreCompiler:
                 assert sv["kind"] == "scalar"
                 a.push(sv["slot"])
                 a.op("SSTORE")
+            return False
+        m = re.match(r"(\w+)\((.*)\)$", s, re.DOTALL)
+        if m and m.group(1) in self.fns:       # bare internal call
+            fn = self.fns[m.group(1)]
+            self.eval_call(_Parser(_tokenize(s)).expr())
+            if fn.returns is not None:
+                a.op("POP")                    # discarded return value
             return False
         raise SyntaxError(f"unhandled statement: {s}")
 
@@ -661,18 +700,29 @@ class SpectreCompiler:
         a = self.a
         self.cur_fn = fn
         self.var_types = {}
+        self.struct_bases = {}
+        self.cd_arrays = {}
         a.label(f"fn_{fn.name}")
         stack_params = []
+        cd_off = 4
         for typ, loc, name in fn.params:
             if typ in self.structs:
-                assert self.struct_bases.get(name, 4) == 4
+                assert cd_off == 4, "struct param must come first"
                 self.struct_bases[name] = 4
                 self.var_types[name] = typ
+                cd_off += 32 * len(self.structs[typ])
+            elif typ.endswith("]"):              # uint256[12] calldata
+                n = int(typ[typ.index("[") + 1:-1])
+                self.cd_arrays[name] = (cd_off, n)
+                self.var_types[name] = typ
+                cd_off += 32 * n
             elif typ == "bytes":
                 self.var_types[name] = "bytes"   # len/data slots, stub-set
+                cd_off += 32
             else:
                 stack_params.append(name)
                 self.var_types[name] = typ
+                cd_off += 32
         for name in reversed(stack_params):      # last arg is on top
             a.push(self.lslot(name))
             a.op("MSTORE")
@@ -702,6 +752,8 @@ class SpectreCompiler:
                         a.pushl(self.revert_label("abi: uint64"))
                         a.op("JUMPI")
                 head_off += 32 * len(self.structs[typ])
+            elif typ.endswith("]"):              # fixed array: inline words
+                head_off += 32 * int(typ[typ.index("[") + 1:-1])
             elif typ == "bytes":
                 bytes_params.append((name, head_off))
                 head_off += 32
@@ -835,8 +887,9 @@ class SpectreCompiler:
         a = self.a
         entries = []
         for fn in self.fns.values():
-            entries.append((fn.selector_sig(self.structs),
-                            f"stub_{fn.name}"))
+            if fn.external:
+                entries.append((fn.selector_sig(self.structs),
+                                f"stub_{fn.name}"))
         for name in self.constants:
             entries.append((f"{name}()", f"stub_get_{name}"))
         for name, sv in self.storage_vars.items():
@@ -845,7 +898,8 @@ class SpectreCompiler:
             entries.append((sig, f"stub_get_{name}"))
         self._dispatcher(entries)
         for fn in self.fns.values():
-            self._abi_stub(fn)
+            if fn.external:
+                self._abi_stub(fn)
         for fn in self.fns.values():
             self.compile_fn(fn)
         for name in list(self.constants) + list(self.storage_vars):

@@ -434,9 +434,11 @@ def _run(fr: _Frame) -> bytes:
                 else:
                     raise EvmError(f"call to unknown account {addr:#x}")
                 fr.returndata = out
-                if ok:
-                    fr.mem[roff:roff + min(rsize, len(out))] = \
-                        out[:min(rsize, len(out))]
+                # geth copies returndata into [roff, rsize) on success AND
+                # on REVERT (exceptional halts return no data)
+                n_copy = min(rsize, len(out))
+                if n_copy:
+                    fr.mem[roff:roff + n_copy] = out[:n_copy]
                 stack.append(1 if ok else 0)
             elif op == 0x54:                   # SLOAD
                 if fr.world is None:
@@ -462,8 +464,16 @@ def _run(fr: _Frame) -> bytes:
                     cost = 20000 if orig == 0 else 2900
                     if orig != 0 and val == 0:
                         w.refund += 4800
-                else:                          # dirty slot
+                else:                          # dirty slot (EIP-3529 rules)
                     cost = 100
+                    if orig != 0:
+                        if cur == 0:           # un-clearing: revoke refund
+                            w.refund -= 4800
+                        elif val == 0:
+                            w.refund += 4800
+                    if val == orig:            # restored to original
+                        w.refund += (20000 - 100) if orig == 0 \
+                            else (2900 - 100)
                 _charge(fr, cold + cost)
                 if val:
                     st[key] = val
@@ -593,9 +603,15 @@ class World:
         self.refund = refund
 
     def deploy(self, init_code: bytes, ctor_args: bytes = b"",
-               gas: int = 30_000_000) -> tuple[int, int]:
+               gas: int = 30_000_000,
+               enforce_eip170: bool = True) -> tuple[int, int]:
         """Run constructor (args appended to init code, solc-style);
-        registers the returned runtime. Returns (address, gas_used)."""
+        registers the returned runtime. Returns (address, gas_used).
+
+        enforce_eip170=False admits oversized runtimes a real chain would
+        reject — for exercising verifiers whose measured size exceeds the
+        limit (the measurement itself is the honest result; callers must
+        record it)."""
         addr = self._next_addr
         self._next_addr += 1
         self.contracts[addr] = Contract(b"")   # storage visible to ctor
@@ -607,7 +623,9 @@ class World:
             raise EvmError(f"constructor reverted: "
                            f"{revert_reason(runtime) or runtime.hex()}")
         self.contracts[addr].code = runtime
-        return addr, used + _enforce_code_deposit(runtime)
+        deposit = _enforce_code_deposit(runtime) if enforce_eip170 \
+            else 200 * len(runtime)
+        return addr, used + deposit
 
     def transact(self, to: int, calldata: bytes, gas: int = 30_000_000,
                  caller: int = 0xCA11E12):
@@ -616,9 +634,11 @@ class World:
         self.begin_tx()
         self._warm_addrs.add(to)
         ok, out, used = self.message_call(to, calldata, gas, caller=caller)
+        total = used + tx_intrinsic_gas(calldata)
         if ok:
-            used -= min(self.refund, used // 5)
-        return ok, out, used + tx_intrinsic_gas(calldata)
+            # EIP-3529: the refund cap is gas_used/5 INCLUDING intrinsic
+            total -= min(max(self.refund, 0), total // 5)
+        return ok, out, total
 
     def call_view(self, to: int, calldata: bytes, gas: int = 30_000_000):
         """eth_call-style read; no intrinsic gas added."""

@@ -7,6 +7,8 @@ bytecode by evm/solc_spectre.py and driven through evm/vm.py's World —
 constructor, storage, keccak mapping slots, the sha256 precompile, and
 real STATICCALLs into deployed verifier contracts, with metered gas."""
 
+import os
+
 import pytest
 
 from spectre_tpu import spec as SP
@@ -18,6 +20,8 @@ from spectre_tpu.evm.solc_spectre import compile_spectre
 from spectre_tpu.plonk.transcript import keccak256
 
 TINY = SP.SPECS["tiny"]
+BUILD = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))), "build")
 STEP_SIG = "step((uint64,uint64,uint64,bytes32,bytes32),bytes)"
 ROTATE_SIG = "rotate(uint256,uint256,uint256,uint256,bytes)"
 
@@ -304,6 +308,108 @@ class TestStorageGasRealism:
         ok, _, gas2 = d.transact(_step_calldata(inp, b""))
         assert ok
         assert gas2 < gas1 - 30000
+
+
+class TestFullStackCompressed:
+    """THE production on-chain flow, all real, all bytecode: the checked-in
+    Testnet-512 compressed step proof -> Spectre.stepCompressed -> real
+    STATICCALL into the COMPILED flagship aggregation verifier -> protocol
+    state advances. Mirrors what a mainnet relayer transaction does
+    (reference: `rpc.rs:114-163` proof gen + the contract step call)."""
+
+    SOL = os.path.join(BUILD, "aggregation_sync_step_testnet_21_verifier.sol")
+    PROOF = os.path.join(BUILD, "agg_step_testnet_21_keccak.proof")
+
+    @pytest.fixture(scope="class")
+    def stack(self):
+        if not (os.path.exists(self.SOL) and os.path.exists(self.PROOF)):
+            pytest.skip("flagship artifacts not in build/")
+        import json
+
+        from spectre_tpu.evm.solc import compile_verifier
+        from spectre_tpu.witness.step import default_sync_step_args as \
+            default_step_args
+        with open(self.SOL) as f:
+            vsrc = f.read()
+        with open(self.PROOF, "rb") as f:
+            proof = f.read()
+        with open(self.PROOF + ".instances.json") as f:
+            stmt = [int(v, 16) for v in json.load(f)["instances"]]
+        assert len(stmt) == 14                 # 12 acc limbs + [commit, pos]
+        spec = SP.SPECS["testnet"]
+        args = default_step_args(spec)
+        inp = StepInput(
+            attested_slot=args.attested_header.slot,
+            finalized_slot=args.finalized_header.slot,
+            participation=sum(args.participation_bits),
+            finalized_header_root=args.finalized_header.hash_tree_root(),
+            execution_payload_root=args.execution_payload_root)
+        assert inp.to_public_inputs_commitment() == stmt[12], \
+            "fixture drift: StepInput does not produce the proof's commitment"
+
+        w = V.World()
+        vrt, vinit, vmeta = compile_verifier(vsrc)
+        # the measured flagship verifier exceeds EIP-170 (recorded in the
+        # flow record); deploy with the limit waived to exercise the flow
+        step_v, _ = w.deploy(vinit, enforce_eip170=False)
+        rot_v, _ = w.deploy(_mock_verifier(True))
+        runtime, init, _ = compile_spectre(gen_spectre_sol(spec))
+        period = inp.attested_slot // spec.slots_per_period
+        ctor = b"".join(int(v).to_bytes(32, "big")
+                        for v in (period, stmt[13], step_v, rot_v))
+        spectre, _ = w.deploy(init, ctor)
+        return w, spectre, inp, stmt, proof, vmeta
+
+    @staticmethod
+    def _calldata(inp: StepInput, acc: list, proof: bytes) -> bytes:
+        sig = ("stepCompressed((uint64,uint64,uint64,bytes32,bytes32),"
+               "uint256[12],bytes)")
+        cd = _sel(sig)
+        cd += inp.attested_slot.to_bytes(32, "big")
+        cd += inp.finalized_slot.to_bytes(32, "big")
+        cd += inp.participation.to_bytes(32, "big")
+        cd += inp.finalized_header_root + inp.execution_payload_root
+        for v in acc:
+            cd += int(v).to_bytes(32, "big")
+        cd += (32 * 18).to_bytes(32, "big")    # proof head (5+12+1 words)
+        cd += len(proof).to_bytes(32, "big") + proof
+        if len(proof) % 32:
+            cd += b"\x00" * (32 - len(proof) % 32)
+        return cd
+
+    def test_real_proof_advances_chain_state(self, stack):
+        w, spectre, inp, stmt, proof, vmeta = stack
+        ok, out, gas = w.transact(spectre, self._calldata(
+            inp, stmt[:12], proof), gas=100_000_000)
+        assert ok, V.revert_reason(out)
+        # protocol post-state
+        head = int.from_bytes(
+            w.call_view(spectre, _sel("head()"))[1], "big")
+        assert head == inp.finalized_slot
+        # end-to-end gas: protocol + full in-EVM SNARK verification
+        assert 1_000_000 < gas < 2_000_000, gas
+
+    def test_tampered_proof_rejected_on_chain(self, stack):
+        w, spectre, inp, stmt, proof, vmeta = stack
+        bad = bytearray(proof)
+        bad[41] ^= 1
+        ok, out, _ = w.transact(spectre, self._calldata(
+            inp, stmt[:12], bytes(bad)), gas=100_000_000)
+        assert not ok
+        # the verifier's revert bubbles through the protocol contract
+        assert V.revert_reason(out) in ("identity", "eval range",
+                                        "ecMul", "ecAdd", "pairing")
+
+    def test_tampered_accumulator_rejected_on_chain(self, stack):
+        w, spectre, inp, stmt, proof, vmeta = stack
+        acc = list(stmt[:12])
+        acc[0] = (acc[0] + 1) % (1 << 88)
+        ok, out, _ = w.transact(spectre, self._calldata(
+            inp, acc, proof), gas=100_000_000)
+        # instances feed the transcript: verifier returns false or the
+        # deferred pairing fails -> require reverts in the protocol
+        assert not ok
+        assert V.revert_reason(out) in ("step proof invalid", "identity")
 
 
 def _raw_contract(build) -> bytes:
