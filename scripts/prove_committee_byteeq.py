@@ -6,8 +6,22 @@ with the SAME seeded blinding — the proofs must be byte-equal (the backends
 differ in where the math runs, never in what they compute). Phase timers on;
 writes the record to build/committee_byteeq_<spec>_<k>.json.
 
-Run: JAX_PLATFORMS=cpu SPECTRE_TRACE=1 python scripts/prove_committee_byteeq.py [spec] [k]
+Phases (r5 lesson: the axon tunnel wedges LONG-LIVED connections mid-bulk-
+transfer — a keygen routed through the ambient device platform blocked in
+tcp_recvmsg for 30+ min while fresh connections worked fine):
+
+  cpu  — JAX pinned to CPU: keygen (pk lands in the params cache) + the
+         CpuBackend prove; writes the proof bytes + record.
+  tpu  — ambient device platform, FRESH process/connection: loads the pk
+         from cache, proves on TpuBackend (device quotient on), compares
+         byte-for-byte against the cpu phase's proof.
+  all  — both in-process (the original single-process flow; only sensible
+         when the ambient platform is already CPU).
+
+Run:
+  python scripts/prove_committee_byteeq.py [spec] [k] [--phase cpu|tpu|all]
 """
+import argparse
 import json
 import os
 import random
@@ -15,17 +29,44 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("SPECTRE_TRACE", "1")
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("spec", nargs="?", default="testnet")
+    ap.add_argument("k", nargs="?", type=int, default=18)
+    ap.add_argument("--phase", choices=("cpu", "tpu", "all"), default="all")
+    opts = ap.parse_args()
+    phase, spec_name, k = opts.phase, opts.spec, opts.k
+
     import jax
-    if "JAX_PLATFORMS" not in os.environ or \
-            os.environ["JAX_PLATFORMS"] == "cpu":
-        # sitecustomize pins the (historically wedged) axon platform; pin CPU
-        # unless the operator explicitly requested a device platform
+    if phase == "cpu" or (phase == "all" and
+                          os.environ.get("JAX_PLATFORMS", "axon")
+                          in ("", "cpu", "axon")):
+        # The box ambient is JAX_PLATFORMS=axon (sitecustomize) — the
+        # historically wedged tunnel. The cpu phase pins CPU UNCONDITIONALLY
+        # (the flag IS the operator intent; ambient axon is the box default,
+        # not a request); 'all' pins CPU unless the operator explicitly
+        # named a non-axon device platform. The tpu phase keeps the ambient
+        # platform — pinning CPU there would record trivially-true "byte
+        # equality" that never touched the device.
         jax.config.update("jax_platforms", "cpu")
+    if phase == "tpu":
+        # sitecustomize's axon plugin registration is itself flaky: when it
+        # fails, JAX_PLATFORMS may still name 'axon' (now an unknown
+        # backend, so default_backend() raises), or auto-choice may silently
+        # land on CPU — either way the "tpu" prove would be meaningless.
+        # Fall back to auto-choice, then REQUIRE a device: a CPU-vs-CPU byte
+        # comparison must never masquerade as hardware evidence.
+        try:
+            backend = jax.default_backend()
+        except RuntimeError:
+            jax.config.update("jax_platforms", "")
+            backend = jax.default_backend()
+        assert backend != "cpu", \
+            "tpu phase resolved to the CPU platform (axon plugin absent or " \
+            "tunnel down) — rerun when a device is reachable"
     from spectre_tpu.plonk.backend import setup_compile_cache
     setup_compile_cache()
 
@@ -37,8 +78,15 @@ def main():
     from spectre_tpu.plonk.srs import SRS
     from spectre_tpu.witness.rotation import default_committee_update_args
 
-    spec = S.SPECS[sys.argv[1] if len(sys.argv) > 1 else "testnet"]
-    k = int(sys.argv[2]) if len(sys.argv) > 2 else 18
+    spec = S.SPECS[spec_name]
+    cpu_proof_path = os.path.join(BUILD_DIR,
+                                  f"committee_byteeq_{spec.name}_{k}.cpu.proof")
+    record_path = os.path.join(BUILD_DIR,
+                               f"committee_byteeq_{spec.name}_{k}.json")
+    if phase == "tpu":
+        assert os.path.exists(cpu_proof_path), \
+            "run --phase cpu first (the byte-equality oracle)"
+
     t0 = time.time()
     args = default_committee_update_args(spec)
     print(f"[{time.time()-t0:7.1f}s] fixture ({spec.sync_committee_size} keys)",
@@ -51,8 +99,18 @@ def main():
     print(f"[{time.time()-t0:7.1f}s] assignment ready", flush=True)
 
     record = {"spec": spec.name, "k": k}
+    if os.path.exists(record_path):
+        with open(record_path) as f:
+            record.update(json.load(f))
+    if phase != "tpu":
+        # a fresh cpu oracle invalidates any earlier comparison: the stale
+        # byte_identical/tpu numbers must not survive into the new record
+        for stale in ("byte_identical", "tpu_prove_s", "tpu_platform"):
+            record.pop(stale, None)
+
+    backends = {"cpu": ("cpu",), "tpu": ("tpu",), "all": ("cpu", "tpu")}[phase]
     proofs = {}
-    for name in ("cpu", "tpu"):
+    for name in backends:
         bk = B.get_backend(name)
         rng = random.Random(0xBEEF)
         t = time.time()
@@ -62,19 +120,38 @@ def main():
         print(f"[{time.time()-t0:7.1f}s] {name} prove: "
               f"{record[f'{name}_prove_s']}s, {len(proofs[name])} bytes",
               flush=True)
-    assert proofs["cpu"] == proofs["tpu"], \
-        "backend proofs DIVERGE at reference scale"
-    record["byte_identical"] = True
-    record["proof_bytes"] = len(proofs["cpu"])
+
+    if phase in ("cpu", "all"):
+        with open(cpu_proof_path, "wb") as f:
+            f.write(proofs["cpu"])
+    if phase == "tpu":
+        record["tpu_platform"] = jax.default_backend()
+        # persist the device proof and the timings BEFORE the comparison: a
+        # divergence — the event this script exists to detect — must leave
+        # both artifacts on disk, not die with a bare assert
+        with open(cpu_proof_path[:-len(".cpu.proof")] + ".tpu.proof",
+                  "wb") as f:
+            f.write(proofs["tpu"])
+        with open(record_path, "w") as f:
+            json.dump(record, f, indent=1)
+        with open(cpu_proof_path, "rb") as f:
+            proofs["cpu"] = f.read()
+
+    if "cpu" in proofs and "tpu" in proofs:
+        assert proofs["cpu"] == proofs["tpu"], \
+            "backend proofs DIVERGE at reference scale " \
+            f"(artifacts: {cpu_proof_path}[.tpu.proof])"
+        record["byte_identical"] = True
+    record["proof_bytes"] = len(proofs[backends[-1]])
     inst = CommitteeUpdateCircuit.get_instances(args, spec)
-    ok = CommitteeUpdateCircuit.verify(pk.vk, srs, inst, proofs["cpu"])
+    ok = CommitteeUpdateCircuit.verify(pk.vk, srs, inst, proofs[backends[-1]])
     assert ok, "proof does not verify"
     record["verifies"] = True
-    out = os.path.join(BUILD_DIR, f"committee_byteeq_{spec.name}_{k}.json")
-    with open(out, "w") as f:
+    with open(record_path, "w") as f:
         json.dump(record, f, indent=1)
-    print(f"[{time.time()-t0:7.1f}s] BYTE-IDENTICAL + verifies -> {out}",
-          flush=True)
+    tag = ("BYTE-IDENTICAL + verifies" if record.get("byte_identical")
+           else f"phase {phase} done, verifies")
+    print(f"[{time.time()-t0:7.1f}s] {tag} -> {record_path}", flush=True)
 
 
 if __name__ == "__main__":

@@ -120,21 +120,40 @@ def main():
         save(evidence)
 
     # -- 5. real prove on TpuBackend + byte-equality vs CpuBackend --
+    # Two phases with SEPARATE deadlines (r5 lesson: the tunnel wedges
+    # long-lived connections mid-bulk-transfer; a keygen routed through the
+    # ambient platform hung in tcp_recvmsg while fresh connections worked).
+    # Phase cpu pins JAX to CPU (keygen + CpuBackend prove, pk cached);
+    # phase tpu is a fresh process on the ambient platform, bounded tighter
+    # so a wedge costs 90 min, not 4 h.
     if quick:
-        evidence["stages"]["byteeq_512"] = {"rc": "skipped",
+        evidence["stages"]["byteeq_cpu"] = {"rc": "skipped",
+                                            "reason": "--quick"}
+        evidence["stages"]["byteeq_tpu"] = {"rc": "skipped",
                                             "reason": "--quick"}
     else:
-        env = {"SPECTRE_TRACE": "1"}
-        if on_device:
-            # let the byteeq script inherit the ambient (device) platform
-            env["JAX_PLATFORMS"] = os.environ.get("JAX_PLATFORMS", "")
+        byteeq = os.path.join(REPO, "scripts", "prove_committee_byteeq.py")
+        cpu = run_stage(evidence, "byteeq_cpu",
+                        [sys.executable, byteeq, "testnet", "18",
+                         "--phase=cpu"],
+                        {"SPECTRE_TRACE": "1", "JAX_PLATFORMS": "cpu"},
+                        timeout=3 * 3600)
+        if not on_device:
+            evidence["stages"]["byteeq_tpu"] = {
+                "rc": "skipped", "reason": "device unreachable"}
+            save(evidence)
+        elif cpu.get("rc") != 0:
+            evidence["stages"]["byteeq_tpu"] = {
+                "rc": "skipped", "reason": "cpu phase failed"}
+            save(evidence)
         else:
-            env["JAX_PLATFORMS"] = "cpu"
-        run_stage(evidence, "byteeq_512",
-                  [sys.executable,
-                   os.path.join(REPO, "scripts", "prove_committee_byteeq.py"),
-                   "testnet", "18"],
-                  env, timeout=4 * 3600)
+            # run_stage merges os.environ, so the ambient platform (axon)
+            # already propagates; the script itself guards against a
+            # silent CPU resolution
+            run_stage(evidence, "byteeq_tpu",
+                      [sys.executable, byteeq, "testnet", "18",
+                       "--phase=tpu"],
+                      {"SPECTRE_TRACE": "1"}, timeout=90 * 60)
 
     evidence["finished_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                              time.gmtime())
