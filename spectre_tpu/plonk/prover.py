@@ -328,11 +328,81 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
     return tr.finalize()
 
 
+class _BudgetedExtLRU:
+    """Byte-budgeted LRU over derived extended-coset arrays (OOM guard).
+
+    Every entry is pure DERIVED data — an NTT of a coeff-form polynomial the
+    prover still holds, or a cyclic roll of another entry — so eviction
+    costs recompute time, never correctness. The guard exists because the
+    unbounded caches held one 512 MB extended array per distinct (column)
+    and (column, rotation): the committee-update aggregation circuit
+    (63.7M cells, k_agg=22, r5) accumulated ~250 of them and the prover was
+    oom-killed at 130 GB. Budget: SPECTRE_QUOTIENT_CACHE_MB, default 30% of
+    MemTotal (min 4 GB) — small circuits stay fully cached, huge ones evict
+    cold families instead of dying."""
+
+    def __init__(self, budget_bytes: int):
+        import collections
+        self.budget = budget_bytes
+        self._d = collections.OrderedDict()
+        self._bytes = 0
+        self._warned_passthrough = False
+
+    def get(self, key):
+        hit = self._d.get(key)
+        if hit is not None:
+            self._d.move_to_end(key)
+        return hit
+
+    def put(self, key, arr):
+        if arr.nbytes > self.budget:
+            # larger than the whole budget: pass through uncached — every
+            # read of this key recomputes a 4n NTT/roll, so make the
+            # misconfiguration visible once rather than silently burning
+            # the quotient phase
+            if not self._warned_passthrough:
+                self._warned_passthrough = True
+                import sys
+                print(f"[quotient] extended array ({arr.nbytes >> 20} MB) "
+                      f"exceeds SPECTRE_QUOTIENT_CACHE_MB budget "
+                      f"({self.budget >> 20} MB): caching disabled, every "
+                      f"read recomputes", file=sys.stderr, flush=True)
+            return arr
+        while self._bytes + arr.nbytes > self.budget and self._d:
+            _, old = self._d.popitem(last=False)
+            self._bytes -= old.nbytes
+        self._d[key] = arr
+        self._bytes += arr.nbytes
+        return arr
+
+
+def _meminfo_total_bytes():
+    try:
+        with open("/proc/meminfo") as f:
+            return int(f.readline().split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _quotient_budget_bytes(pk_ext_budget: int) -> int:
+    """LRU budget: explicit env, else 30% of RAM MINUS the coexisting
+    pk-resident fixed-column cache budget — the two caches draw from one
+    memory pool, so bounding them independently would not bound the
+    prover (the r5 oom-kill lesson)."""
+    import os as _os
+    mb = _os.environ.get("SPECTRE_QUOTIENT_CACHE_MB")
+    if mb is not None:
+        return int(mb) << 20
+    total = _meminfo_total_bytes()
+    if total is None:
+        return 8 << 30
+    return max(1 << 30, int(total * 0.30) - pk_ext_budget)
+
+
 def _quotient_host(cfg, dom, bk, pk, polys, beta, gamma, y):
     """The original host-orchestrated quotient: per-op backend calls over
     the extended coset (CPU path)."""
     n, u = cfg.n, cfg.usable_rows
-    ext_cache: dict = {}
     # Circuit-FIXED columns (selectors, fixed, sigmas, tables) have the same
     # extended form every prove; their ~n-per-circuit 4n-NTTs were about half
     # of quotient wall-clock (BASELINE.md r4: quotient 41-49% of prove).
@@ -341,20 +411,28 @@ def _quotient_host(cfg, dom, bk, pk, polys, beta, gamma, y):
     _FIXED_KINDS = ("q", "fix", "sig", "tab", "shq", "shk")
     pk_ext = pk.__dict__.setdefault("_ext_fixed_cache", {})
     # cap resident bytes per pk (idle-circuit caches stack in a service —
-    # see ProvingKey.release_ext_cache); over budget we compute transiently
+    # see ProvingKey.release_ext_cache); over budget we compute transiently.
+    # Default: min(16 GB, 15% of RAM) — shares one pool with the LRU below
     import os as _os
-    ext_budget = int(_os.environ.get("SPECTRE_EXT_CACHE_MB", "16384")) << 20
+    _mb = _os.environ.get("SPECTRE_EXT_CACHE_MB")
+    if _mb is not None:
+        ext_budget = int(_mb) << 20
+    else:
+        _total = _meminfo_total_bytes()
+        ext_budget = (16 << 30 if _total is None
+                      else min(16 << 30, int(_total * 0.15)))
+    lru = _BudgetedExtLRU(_quotient_budget_bytes(ext_budget))
 
     def _within_budget(arr):
         return (sum(a.nbytes for a in pk_ext.values()) + arr.nbytes
                 <= ext_budget)
 
     def ext(key):
-        if key in ext_cache:
-            return ext_cache[key]
+        hit = lru.get(key)
+        if hit is not None:
+            return hit
         if key in polys:
-            ext_cache[key] = dom.coeff_to_extended(polys[key], bk)
-            return ext_cache[key]
+            return lru.put(key, dom.coeff_to_extended(polys[key], bk))
         if key[0] in _FIXED_KINDS:
             hit = pk_ext.get(key)
             if hit is None:
@@ -374,12 +452,10 @@ def _quotient_host(cfg, dom, bk, pk, polys, beta, gamma, y):
                 if _within_budget(hit):
                     pk_ext[key] = hit
                 else:
-                    ext_cache[key] = hit   # per-prove lifetime only
+                    hit = lru.put(key, hit)   # per-prove lifetime only
             return hit
         # ("inst", j) is pre-populated in polys by prove()
         raise KeyError(key)
-
-    rot_cache: dict = {}
 
     class LazyCtx(_ArrayCtx):
         def var(self, key, rot):
@@ -387,15 +463,16 @@ def _quotient_host(cfg, dom, bk, pk, polys, beta, gamma, y):
             if rot == 0:
                 return arr
             # a (key, rot) pair is read by several expressions; rolling a
-            # 4n-row array per read was measurable quotient time
-            hit = rot_cache.get((key, rot))
+            # 4n-row array per read was measurable quotient time — but the
+            # rolled copies share the byte budget with the base arrays
+            rkey = (key, "rot", rot)
+            hit = lru.get(rkey)
             if hit is None:
                 r = cfg.last_row if rot == ROT_LAST else rot
-                hit = dom.rotate_extended(arr, r)
-                rot_cache[(key, rot)] = hit
+                hit = lru.put(rkey, dom.rotate_extended(arr, r))
             return hit
 
-    ctx = LazyCtx(cfg, dom, bk, ext_cache)
+    ctx = LazyCtx(cfg, dom, bk, {})
     # l0 / l_last / l_blind on the extended coset — circuit-fixed, cached
     # alongside the fixed-column extended forms
     if ("l0",) not in pk_ext:
