@@ -36,8 +36,13 @@ def perm_column_keys(cfg: CircuitConfig):
 def all_expressions(cfg: CircuitConfig, c, beta: int, gamma: int):
     """Ordered constraint list. ctx protocol:
     var(key, rot), mul/add/sub, scale(a, int), add_const(a, int), const(int),
-    l0, llast, lblind, x_col (the identity polynomial X)."""
-    exprs = []
+    l0, llast, lblind, x_col (the identity polynomial X).
+
+    A GENERATOR: in the prover each expression is a full extended-domain
+    array (512 MB at k=22), so materializing the whole list before folding
+    held ~50 of them live at once — the r5 oom-kill. Yielding interleaves
+    evaluation with the y-fold, keeping one expression live at a time; the
+    scalar/cell/codegen contexts are indifferent."""
     one = c.const(1)
 
     # --- gates: q_j * (a + a1*a2 - a3) ---
@@ -47,15 +52,15 @@ def all_expressions(cfg: CircuitConfig, c, beta: int, gamma: int):
         a2 = c.var(("adv", j), 2)
         a3 = c.var(("adv", j), 3)
         q = c.var(("q", j), 0)
-        exprs.append(c.mul(q, c.sub(c.add(a0, c.mul(a1, a2)), a3)))
+        yield (c.mul(q, c.sub(c.add(a0, c.mul(a1, a2)), a3)))
 
     # --- permutation argument ---
     col_keys = perm_column_keys(cfg)
     nch = cfg.num_perm_chunks
     act = c.sub(one, c.add(c.llast, c.lblind))
-    exprs.append(c.mul(c.l0, c.sub(c.var(("pz", 0), 0), one)))
+    yield (c.mul(c.l0, c.sub(c.var(("pz", 0), 0), one)))
     for ch in range(1, nch):
-        exprs.append(c.mul(c.l0, c.sub(c.var(("pz", ch), 0),
+        yield (c.mul(c.l0, c.sub(c.var(("pz", ch), 0),
                                        c.var(("pz", ch - 1), ROT_LAST))))
     for ch in range(nch):
         cols = list(enumerate(col_keys))[ch * PERM_CHUNK:(ch + 1) * PERM_CHUNK]
@@ -68,9 +73,9 @@ def all_expressions(cfg: CircuitConfig, c, beta: int, gamma: int):
             dj = pow(DELTA, gidx, R)
             right = c.mul(right, c.add_const(
                 c.add(v, c.scale(c.x_col, beta * dj % R)), gamma))
-        exprs.append(c.mul(act, c.sub(left, right)))
+        yield (c.mul(act, c.sub(left, right)))
     zl = c.var(("pz", nch - 1), 0)
-    exprs.append(c.mul(c.llast, c.sub(c.mul(zl, zl), zl)))
+    yield (c.mul(c.llast, c.sub(c.mul(zl, zl), zl)))
 
     # --- lookups (range table) ---
     for j in range(cfg.num_lookup_advice):
@@ -81,23 +86,21 @@ def all_expressions(cfg: CircuitConfig, c, beta: int, gamma: int):
         tab = c.var(("tab", j), 0)
         lz = c.var(("lz", j), 0)
         lz1 = c.var(("lz", j), 1)
-        exprs.append(c.mul(c.l0, c.sub(lz, one)))
+        yield (c.mul(c.l0, c.sub(lz, one)))
         left = c.mul(lz1, c.mul(c.add_const(pa, beta), c.add_const(pt, gamma)))
         right = c.mul(lz, c.mul(c.add_const(a, beta), c.add_const(tab, gamma)))
-        exprs.append(c.mul(act, c.sub(left, right)))
+        yield (c.mul(act, c.sub(left, right)))
         # Boundary: lz(last) in {0,1}. Without this the lookup grand product's
         # final value is unconstrained and the A'~A / T'~T permutation relation
         # is never enforced (a prover could set A'=T'=table and "look up"
         # arbitrary advice). Mirrors the permutation z boundary above; lz at
         # rotation 0 is already in the query plan, so no new openings.
-        exprs.append(c.mul(c.llast, c.sub(c.mul(lz, lz), lz)))
-        exprs.append(c.mul(c.l0, c.sub(pa, pt)))
-        exprs.append(c.mul(act, c.mul(c.sub(pa, pt), c.sub(pa, pa_prev))))
+        yield (c.mul(c.llast, c.sub(c.mul(lz, lz), lz)))
+        yield (c.mul(c.l0, c.sub(pa, pt)))
+        yield (c.mul(act, c.mul(c.sub(pa, pt), c.sub(pa, pa_prev))))
 
     if cfg.num_sha_slots:
-        exprs.extend(sha_expressions(cfg, c))
-
-    return exprs
+        yield from sha_expressions(cfg, c)
 
 
 def sha_expressions(cfg: CircuitConfig, c):
@@ -114,8 +117,6 @@ def sha_expressions(cfg: CircuitConfig, c):
     6 act-chain. ("shk", 0): per-round K constants."""
     from .constraint_system import (SHA_A, SHA_ACT_WORD, SHA_CARRY, SHA_E,
                                     SHA_W)
-
-    exprs = []
 
     def w(i, rot=0):
         return c.var(("shb", SHA_W + i), rot)
@@ -161,21 +162,21 @@ def sha_expressions(cfg: CircuitConfig, c):
     from .constraint_system import SHA_BIT_COLS
     for j in range(SHA_BIT_COLS):
         b = c.var(("shb", j), 0)
-        exprs.append(c.mul(qb, c.sub(c.mul(b, b), b)))
+        yield (c.mul(qb, c.sub(c.mul(b, b), b)))
     actv = c.var(("shw", SHA_ACT_WORD), 0)
-    exprs.append(c.mul(qb, c.sub(c.mul(actv, actv), actv)))
+    yield (c.mul(qb, c.sub(c.mul(actv, actv), actv)))
 
     # --- act chain: constant within the slot ---
-    exprs.append(c.mul(q(6), c.sub(actv, c.var(("shw", SHA_ACT_WORD), -1))))
+    yield (c.mul(q(6), c.sub(actv, c.var(("shw", SHA_ACT_WORD), -1))))
 
     # --- seed rows bind the a/e ladders to h_in words (q_seed, row 3) ---
     qs = q(1)
     for j in range(4):
-        exprs.append(c.mul(qs, c.sub(recomb(a, -j), c.var(("shw", j), 0))))
-        exprs.append(c.mul(qs, c.sub(recomb(e, -j), c.var(("shw", 4 + j), 0))))
+        yield (c.mul(qs, c.sub(recomb(a, -j), c.var(("shw", j), 0))))
+        yield (c.mul(qs, c.sub(recomb(e, -j), c.var(("shw", 4 + j), 0))))
 
     # --- input rows bind w to the input word column (q_inp, t=0..15) ---
-    exprs.append(c.mul(q(4), c.sub(recomb(w), c.var(("shw", 8), 0))))
+    yield (c.mul(q(4), c.sub(recomb(w), c.var(("shw", 8), 0))))
 
     # --- round identities (q_round, t=0..63) ---
     qr = q(2)
@@ -190,7 +191,7 @@ def sha_expressions(cfg: CircuitConfig, c):
     ce = wsum([c.scale(carry(i), 1 << (32 + i)) for i in range(3)])
     lhs_a = c.add(recomb(e), ce)
     rhs_a = wsum([recomb(a, -4), recomb(e, -4), sig1, ch, k_act, recomb(w)])
-    exprs.append(c.mul(qr, c.sub(lhs_a, rhs_a)))
+    yield (c.mul(qr, c.sub(lhs_a, rhs_a)))
     # sigma0(a[t-1]) and maj(a(t-1), a(t-2), a(t-3))
     sig0 = recomb(lambda i, _r: xor3(a((i + 2) % 32, -1), a((i + 13) % 32, -1),
                                      a((i + 22) % 32, -1)))
@@ -206,7 +207,7 @@ def sha_expressions(cfg: CircuitConfig, c):
     ca = wsum([c.scale(carry(3 + i), 1 << (32 + i)) for i in range(3)])
     lhs_b = wsum([recomb(a), ca, recomb(a, -4)])
     rhs_b = wsum([recomb(e), ce, sig0, maj])
-    exprs.append(c.mul(qr, c.sub(lhs_b, rhs_b)))
+    yield (c.mul(qr, c.sub(lhs_b, rhs_b)))
 
     # --- schedule (q_sched, t=16..63) ---
     # sigma0s: rotr7 ^ rotr18 ^ shr3 on w(t-15); shr3 bit i = w[i+3], 0 for
@@ -228,7 +229,7 @@ def sha_expressions(cfg: CircuitConfig, c):
     cs = wsum([c.scale(carry(6 + i), 1 << (32 + i)) for i in range(2)])
     lhs_s = c.add(recomb(w), cs)
     rhs_s = wsum([recomb(w, -16), recomb(s0bit), recomb(w, -7), recomb(s1bit)])
-    exprs.append(c.mul(q(3), c.sub(lhs_s, rhs_s)))
+    yield (c.mul(q(3), c.sub(lhs_s, rhs_s)))
 
     # --- output row: h_out = h_in + final ladder (q_out, row 68) ---
     qo = q(5)
@@ -238,9 +239,8 @@ def sha_expressions(cfg: CircuitConfig, c):
         fin = recomb(a if j < 4 else e, -(1 + (j % 4)))
         lhs_o = c.add(c.var(("shw", j), 0), c.scale(carry(j), 1 << 32))
         rhs_o = c.add(c.var(("shw", j), back), fin)
-        exprs.append(c.mul(qo, c.sub(lhs_o, rhs_o)))
+        yield (c.mul(qo, c.sub(lhs_o, rhs_o)))
 
-    return exprs
 
 
 class ScalarCtx:

@@ -29,11 +29,11 @@ R = bn254.R
 class _ArrayCtx:
     """Prover-side expression context over extended-domain arrays."""
 
-    def __init__(self, cfg, dom: Domain, bk, ext_cache: dict):
+    def __init__(self, cfg, dom: Domain, bk, ext):
         self._cfg = cfg
         self._dom = dom
         self._bk = bk
-        self._ext = ext_cache
+        self._ext = ext        # key -> extended array (mapping or callable cache)
         # X on the extended coset: g * omega_ext^i (powers domain-cached)
         from .domain import COSET_GEN
         xs = dom._coset_powers(dom.omega_ext, bk)
@@ -338,8 +338,9 @@ class _BudgetedExtLRU:
     and (column, rotation): the committee-update aggregation circuit
     (63.7M cells, k_agg=22, r5) accumulated ~250 of them and the prover was
     oom-killed at 130 GB. Budget: SPECTRE_QUOTIENT_CACHE_MB, default 30% of
-    MemTotal (min 4 GB) — small circuits stay fully cached, huge ones evict
-    cold families instead of dying."""
+    MemTotal minus the pk-resident fixed-column cache budget (floor 1 GB) —
+    small circuits stay fully cached, huge ones evict cold families instead
+    of dying."""
 
     def __init__(self, budget_bytes: int):
         import collections
@@ -459,20 +460,22 @@ def _quotient_host(cfg, dom, bk, pk, polys, beta, gamma, y):
 
     class LazyCtx(_ArrayCtx):
         def var(self, key, rot):
-            arr = ext(key)
             if rot == 0:
-                return arr
+                return ext(key)
             # a (key, rot) pair is read by several expressions; rolling a
             # 4n-row array per read was measurable quotient time — but the
-            # rolled copies share the byte budget with the base arrays
+            # rolled copies share the byte budget with the base arrays.
+            # Check the rolled entry FIRST: under eviction pressure the base
+            # may be gone while the roll survives, and recomputing the base
+            # NTT just to discard it would waste ~a 4n NTT per read
             rkey = (key, "rot", rot)
             hit = lru.get(rkey)
             if hit is None:
                 r = cfg.last_row if rot == ROT_LAST else rot
-                hit = lru.put(rkey, dom.rotate_extended(arr, r))
+                hit = lru.put(rkey, dom.rotate_extended(ext(key), r))
             return hit
 
-    ctx = LazyCtx(cfg, dom, bk, {})
+    ctx = LazyCtx(cfg, dom, bk, ext)
     # l0 / l_last / l_blind on the extended coset — circuit-fixed, cached
     # alongside the fixed-column extended forms
     if ("l0",) not in pk_ext:

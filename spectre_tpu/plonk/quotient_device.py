@@ -202,10 +202,10 @@ def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
             return arr
 
     ctx = _DeviceCtx(LazyCols(cols), m, cfg.last_row, mont_scalar)
-    exprs = all_expressions(cfg, ctx, beta, gamma)
-    acc = exprs[0]
+    exprs = iter(all_expressions(cfg, ctx, beta, gamma))
+    acc = next(exprs)
     y_m = mont_scalar(y)
-    for e in exprs[1:]:
+    for e in exprs:
         acc = h["fold"](acc, y_m, e)
     out = h["h_from_acc"](acc, st["vinv"], st["inv_coset"], dom.omega_ext)
     std = h["from_mont"](out)
