@@ -215,8 +215,13 @@ class VerifierChip:
             lblind = gate.add(ctx, lblind, special[i])
 
         cctx = CellCtx(ctx, gate, evals, l0, llast, lblind, x)
-        exprs = all_expressions(cfg, cctx, _CellChal(ctx, gate, beta),
-                                _CellChal(ctx, gate, gamma))
+        # MATERIALIZE the generator: every expression's cells must be
+        # allocated before the fold's mul_add cells, or the circuit's cell
+        # ordering (and with it every pinned layout, cached pk, and proof)
+        # silently changes. Cells are ints here — the streaming that
+        # matters for the prover's 512 MB arrays costs nothing to undo.
+        exprs = list(all_expressions(cfg, cctx, _CellChal(ctx, gate, beta),
+                                     _CellChal(ctx, gate, gamma)))
         acc = ctx.load_constant(0)
         for e in exprs:
             acc = gate.mul_add(ctx, acc, y, e)
