@@ -1,13 +1,13 @@
 # Build orchestration (reference parity: `justfile` recipes).
 
-.PHONY: all native test test-slow fixtures bench setup-committee setup-step lint tpu-evidence
+.PHONY: all native test test-slow fixtures bench setup-committee setup-step lint lint-fast tpu-evidence
 
 all: native
 
 native:
 	$(MAKE) -C spectre_tpu/native
 
-test: native
+test: native lint
 	python -m pytest tests/ -q
 
 test-slow: native
@@ -33,5 +33,13 @@ bench: native
 tpu-evidence: native
 	python scripts/tpu_evidence.py
 
+# static analysis: compile check + the soundness auditor / kernel lint
+# (spectre_tpu/analysis). Fails on any non-baselined error finding; accepted
+# findings live in spectre_tpu/analysis/baseline.json (see README).
 lint:
 	python -m compileall -q spectre_tpu tests bench.py __graft_entry__.py
+	JAX_PLATFORMS=cpu python -m spectre_tpu.analysis --fail-on error
+
+# kernel-lint only (seconds; the full `lint` builds three tiny circuits)
+lint-fast:
+	JAX_PLATFORMS=cpu python -m spectre_tpu.analysis --engine kernel --fail-on error

@@ -102,11 +102,14 @@ def main():
     if os.path.exists(record_path):
         with open(record_path) as f:
             record.update(json.load(f))
-    if phase != "tpu":
-        # a fresh cpu oracle invalidates any earlier comparison: the stale
-        # byte_identical/tpu numbers must not survive into the new record
-        for stale in ("byte_identical", "tpu_prove_s", "tpu_platform"):
-            record.pop(stale, None)
+    # stale comparison results must never survive into this run's record: a
+    # fresh cpu oracle invalidates any earlier comparison, and a repeated
+    # tpu phase must not inherit a prior run's byte_identical:true — the
+    # pre-comparison write below would otherwise persist it even when THIS
+    # run's device proof diverges (ADVICE r5 medium). byte_identical is
+    # re-set only after the compare passes.
+    for stale in ("byte_identical", "tpu_prove_s", "tpu_platform"):
+        record.pop(stale, None)
 
     backends = {"cpu": ("cpu",), "tpu": ("tpu",), "all": ("cpu", "tpu")}[phase]
     proofs = {}

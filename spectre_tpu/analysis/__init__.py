@@ -1,0 +1,30 @@
+"""Static analysis for the prover: circuit soundness audit + JAX kernel lint.
+
+Two engines, one finding stream (motivation: ISSUE 1 — every MXU/limb
+rewrite of the prover's hot path is a chance to drop a constraint or
+overflow a limb with no test that notices; zkSpeed and SZKP both flag this
+as the cost of porting provers to wide SIMD/systolic datapaths):
+
+- `circuit_audit` walks a builder `Context` + synthesized `CircuitConfig`
+  and reports under-constrained advice cells, degree-budget violations,
+  unbound lookup tables, copy-constraint orphans, and dead (all-zero)
+  fixed/selector columns.
+- `kernel_lint` traces the hot device ops to jaxprs and flags integer
+  multiplies/adds whose worst-case value exceeds the lane dtype, float
+  dtypes leaking into field arithmetic, and host callbacks inside kernels.
+
+CLI: `python -m spectre_tpu.analysis --fail-on error`. Accepted findings
+live in the checked-in `baseline.json` next to this file (see README
+"Static analysis" for the suppression workflow).
+"""
+
+from .findings import (Finding, Severity, load_baseline, write_baseline,
+                       partition_findings, format_finding)
+from .circuit_audit import audit_context, DegreeCtx
+from .kernel_lint import lint_kernel, lint_all_kernels, KERNELS
+
+__all__ = [
+    "Finding", "Severity", "load_baseline", "write_baseline",
+    "partition_findings", "format_finding", "audit_context", "DegreeCtx",
+    "lint_kernel", "lint_all_kernels", "KERNELS",
+]

@@ -1,0 +1,107 @@
+"""CLI: `python -m spectre_tpu.analysis [--fail-on error]`.
+
+Runs both engines (circuit soundness audit over the tiny-spec app circuits,
+kernel lint over the hot device ops), subtracts the checked-in
+`baseline.json` suppressions, prints the rest, and exits nonzero when any
+unsuppressed finding reaches the --fail-on severity. `--write-baseline`
+accepts the current active findings into the suppression file (review the
+diff — every entry is a consciously accepted soundness exception).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spectre_tpu.analysis",
+        description="circuit soundness auditor + JAX kernel lint")
+    ap.add_argument("--engine", choices=("all", "circuit", "kernel"),
+                    default="all")
+    ap.add_argument("--circuits", default="committee_update,sync_step,"
+                    "aggregation",
+                    help="comma list of audit circuits, or 'none'")
+    ap.add_argument("--kernels", default="",
+                    help="comma list of kernel names (default: all)")
+    ap.add_argument("--fail-on", choices=("error", "warning", "never"),
+                    default="error", dest="fail_on")
+    ap.add_argument("--baseline", default=None,
+                    help="suppression file (default: packaged baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept current active findings into the baseline")
+    ap.add_argument("--json", default=None, help="write findings JSON here")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    opts = ap.parse_args(argv)
+
+    from .findings import (Severity, format_finding, load_baseline,
+                           partition_findings, write_baseline)
+
+    findings = []
+    t0 = time.time()
+
+    if opts.engine in ("all", "circuit") and opts.circuits != "none":
+        from .circuit_audit import audit_context
+        from .circuits import AUDIT_CIRCUITS
+        for cname in [c for c in opts.circuits.split(",") if c]:
+            build = AUDIT_CIRCUITS.get(cname)
+            if build is None:
+                ap.error(f"unknown circuit {cname!r} "
+                         f"(have: {', '.join(AUDIT_CIRCUITS)})")
+            t = time.time()
+            ctx, cfg, name = build()
+            fs = audit_context(ctx, cfg, name)
+            findings += fs
+            if not opts.quiet:
+                print(f"[analysis] circuit {name}: {len(fs)} finding(s) "
+                      f"({time.time() - t:.1f}s)", flush=True)
+
+    if opts.engine in ("all", "kernel"):
+        from .kernel_lint import lint_all_kernels
+        t = time.time()
+        names = set(k for k in opts.kernels.split(",") if k) or None
+        fs = lint_all_kernels(names)
+        findings += fs
+        if not opts.quiet:
+            print(f"[analysis] kernel lint: {len(fs)} finding(s) "
+                  f"({time.time() - t:.1f}s)", flush=True)
+
+    baseline = load_baseline(opts.baseline)
+    active, suppressed = partition_findings(findings, baseline)
+
+    if opts.write_baseline and active:
+        path = write_baseline(active, opts.baseline)
+        print(f"[analysis] accepted {len(active)} finding(s) into {path}")
+        suppressed += active
+        active = []
+
+    for f in active:
+        print(format_finding(f))
+    if not opts.quiet:
+        for f in suppressed:
+            print(format_finding(f, suppressed=True))
+
+    if opts.json:
+        with open(opts.json, "w") as fh:
+            json.dump({"active": [f.to_dict() for f in active],
+                       "suppressed": [f.to_dict() for f in suppressed]},
+                      fh, indent=1)
+
+    counts = {}
+    for f in active:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    print(f"[analysis] {len(active)} active finding(s) "
+          f"({', '.join(f'{v} {k}' for k, v in counts.items()) or 'clean'}), "
+          f"{len(suppressed)} baselined, {time.time() - t0:.1f}s total")
+
+    if opts.fail_on == "never":
+        return 0
+    bad = [f for f in active if Severity.at_least(f.severity, opts.fail_on)]
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

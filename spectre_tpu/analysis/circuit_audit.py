@@ -1,0 +1,253 @@
+"""Engine 1: circuit soundness audit over a builder Context + CircuitConfig.
+
+Rules (all keyed for baseline suppression, see findings.py):
+
+  CA-UNDERCONSTRAINED  error    ungated advice cell referenced by no gate,
+                                copy, constant pin, lookup push, or instance
+                                exposure — a free witness the proof never
+                                binds (the classic dropped-constraint bug).
+  CA-DEGREE            error    constraint expression whose column-degree
+                                exceeds cfg.max_expr_degree (the quotient
+                                would not fit NUM_H_CHUNKS committed chunks;
+                                the prover only discovers this at prove time
+                                as an inexact division).
+  CA-TABLE-UNBOUND     error    lookup stream bound to a table id with no
+                                configured lookup-advice column (layout
+                                would assert), or a configured table id the
+                                constraint system cannot synthesize.
+  CA-TABLE-OVERFLOW    error    lookup stream longer than its configured
+                                columns can hold.
+  CA-COPY-ORPHAN       error    copy constraint / constant pin / instance
+                                exposure referencing a cell that was never
+                                assigned (out-of-range stream index, missing
+                                lookup stream, unallocated SHA slot row).
+  CA-DEAD-SELECTOR     warning  all-zero selector column: the gate in that
+                                advice column is never active.
+  CA-DEAD-FIXED        warning  all-zero fixed column (dead constants).
+
+The walk is pure host Python over builder streams — no SRS, no keygen, no
+proving; tiny-spec circuits audit in seconds.
+"""
+
+from __future__ import annotations
+
+from ..plonk.constraint_system import (SHA_SLOT_ROWS, SHA_WORD_COLS,
+                                       CircuitConfig, table_column)
+from ..plonk.expressions import all_expressions
+from .findings import Finding, Severity
+
+_CS_FILE = "spectre_tpu/plonk/constraint_system.py"
+_CTX_FILE = "spectre_tpu/builder/context.py"
+
+
+class DegreeCtx:
+    """all_expressions context computing each expression's column-degree:
+    every column polynomial (advice, fixed, selector, sigma, grand product,
+    l0/llast/lblind, the identity X) counts as degree 1; mul adds degrees,
+    add/sub take the max, scalar ops preserve them. The same protocol the
+    prover/verifier/mock contexts implement, so the audited degrees are the
+    degrees of exactly the expressions that get proven."""
+
+    l0 = 1
+    llast = 1
+    lblind = 1
+    x_col = 1
+
+    def var(self, key, rot):
+        return 1
+
+    def mul(self, a, b):
+        return a + b
+
+    def add(self, a, b):
+        return max(a, b)
+
+    def sub(self, a, b):
+        return max(a, b)
+
+    def scale(self, a, s):
+        return a
+
+    def add_const(self, a, s):
+        return a
+
+    def const(self, s):
+        return 0
+
+
+def expression_degrees(cfg: CircuitConfig, expressions_fn=all_expressions):
+    """Column-degree of every constraint expression, in yield order."""
+    # beta/gamma enter as scalars (degree 0); any nonzero values work
+    return list(expressions_fn(cfg, DegreeCtx(), 0xBEEF, 0xCAFE))
+
+
+def _audit_degrees(cfg, name, expressions_fn) -> list:
+    out = []
+    budget = cfg.max_expr_degree
+    for i, deg in enumerate(expression_degrees(cfg, expressions_fn)):
+        if deg > budget:
+            out.append(Finding(
+                "circuit", "CA-DEGREE", Severity.ERROR, _CS_FILE, name,
+                f"expression #{i} has column-degree {deg} > budget {budget} "
+                f"(quotient would overflow the committed h chunks)",
+                key=f"CA-DEGREE:{name}:expr{i}"))
+    return out
+
+
+def _audit_cell_references(ctx, name) -> list:
+    refs = ctx.cell_references()
+    n, gated, referenced = refs["n_cells"], refs["gated"], refs["referenced"]
+    loose = [i for i in range(n) if not gated[i] and not referenced[i]]
+    if not loose:
+        return []
+    preview = ", ".join(str(i) for i in loose[:8])
+    more = f", ... ({len(loose)} total)" if len(loose) > 8 else ""
+    return [Finding(
+        "circuit", "CA-UNDERCONSTRAINED", Severity.ERROR, _CTX_FILE, name,
+        f"{len(loose)} ungated advice cell(s) with no gate/copy/lookup/"
+        f"instance reference: stream indices [{preview}{more}] — free "
+        f"witnesses the proof never binds",
+        # count in the key: the baseline entry resurfaces if the number of
+        # accepted loose cells ever drifts
+        key=f"CA-UNDERCONSTRAINED:{name}:{len(loose)}")]
+
+
+def _audit_tables(ctx, cfg, name) -> list:
+    out = []
+    configured: dict = {}
+    for j in range(cfg.num_lookup_advice):
+        configured[cfg.table_id(j)] = configured.get(cfg.table_id(j), 0) + 1
+    for tid in configured:
+        try:
+            table_column(cfg, tid)
+        except KeyError:
+            out.append(Finding(
+                "circuit", "CA-TABLE-UNBOUND", Severity.ERROR, _CS_FILE, name,
+                f"configured lookup table id {tid!r} is unknown to "
+                f"table_column() — keygen would fail",
+                key=f"CA-TABLE-UNBOUND:{name}:cfg:{tid}"))
+    u = cfg.usable_rows
+    for tid, stream in ctx.lkp_streams.items():
+        ncols = configured.get(tid, 0)
+        if ncols == 0:
+            out.append(Finding(
+                "circuit", "CA-TABLE-UNBOUND", Severity.ERROR, _CS_FILE, name,
+                f"lookup stream {tid!r} ({len(stream)} cells) has no "
+                f"lookup-advice column bound in cfg.lookup_tables "
+                f"{cfg.lookup_tables!r} — layout would fail and the lookups "
+                f"would never be enforced",
+                key=f"CA-TABLE-UNBOUND:{name}:{tid}"))
+        elif len(stream) > ncols * u:
+            out.append(Finding(
+                "circuit", "CA-TABLE-OVERFLOW", Severity.ERROR, _CS_FILE, name,
+                f"lookup stream {tid!r} has {len(stream)} cells but the "
+                f"{ncols} configured column(s) hold only {ncols * u}",
+                key=f"CA-TABLE-OVERFLOW:{name}:{tid}"))
+    return out
+
+
+def _audit_copy_orphans(ctx, cfg, name) -> list:
+    n_adv = len(ctx.adv_values)
+    n_sha_rows = len(ctx.sha_slots) * SHA_SLOT_ROWS
+
+    def endpoint_error(stream, idx):
+        if stream == "adv":
+            if not (isinstance(idx, int) and 0 <= idx < n_adv):
+                return f"advice index {idx} outside stream of {n_adv}"
+            return None
+        if stream == "shwc":
+            j, row = idx
+            if not 0 <= j < SHA_WORD_COLS:
+                return f"sha word column {j} out of range"
+            if not 0 <= row < n_sha_rows:
+                return (f"sha word row {row} outside the "
+                        f"{len(ctx.sha_slots)} allocated slot(s)")
+            return None
+        if isinstance(stream, tuple) and stream and stream[0] == "lkp":
+            tid = stream[1]
+            st = ctx.lkp_streams.get(tid)
+            if st is None:
+                return f"lookup stream {tid!r} does not exist"
+            if not 0 <= idx < len(st):
+                return f"lookup index {idx} outside {tid!r} stream of {len(st)}"
+            return None
+        return f"unknown stream kind {stream!r}"
+
+    out = []
+    seen = set()
+
+    def report(detail, where):
+        if detail in seen:
+            return
+        seen.add(detail)
+        out.append(Finding(
+            "circuit", "CA-COPY-ORPHAN", Severity.ERROR, _CTX_FILE, name,
+            f"{where} references an unassigned cell: {detail} — the "
+            f"permutation cycle would touch a cell no column carries",
+            key=f"CA-COPY-ORPHAN:{name}:{detail}"))
+
+    for (sa, ia), (sb, ib) in ctx.copies:
+        for s, i in ((sa, ia), (sb, ib)):
+            err = endpoint_error(s, i)
+            if err:
+                report(err, "copy constraint")
+    n_const_rows = len(ctx.constants)
+    for adv_idx, fix_row in ctx.const_uses:
+        if not 0 <= adv_idx < n_adv:
+            report(f"advice index {adv_idx} outside stream of {n_adv}",
+                   "constant pin")
+        if not 0 <= fix_row < n_const_rows:
+            report(f"fixed row {fix_row} outside the {n_const_rows} "
+                   f"interned constants", "constant pin")
+    for av in ctx.instance_cells:
+        err = endpoint_error(av.stream, av.index)
+        if err:
+            report(err, "instance exposure")
+    if len(ctx.instance_cells) > cfg.usable_rows:
+        report(f"{len(ctx.instance_cells)} instance cells exceed "
+               f"usable rows {cfg.usable_rows}", "instance column")
+    return out
+
+
+def _audit_dead_columns(ctx, cfg, name) -> list:
+    out = []
+    try:
+        _adv, _lkp, fixed, selectors, _cp, _inst, _bp = ctx.layout(cfg)
+    except (AssertionError, KeyError) as e:
+        # a broken layout is already reported by the orphan/table rules;
+        # surface the failure rather than crash the audit
+        return [Finding(
+            "circuit", "CA-DEAD-FIXED", Severity.WARNING, _CTX_FILE, name,
+            f"layout failed ({e}) — dead-column audit skipped",
+            key=f"CA-LAYOUT-FAILED:{name}")]
+    for j, col in enumerate(selectors):
+        if not any(col):
+            out.append(Finding(
+                "circuit", "CA-DEAD-SELECTOR", Severity.WARNING, _CS_FILE,
+                name,
+                f"selector column {j} is all-zero: the vertical gate in "
+                f"advice column {j} is never active (dead gate)",
+                key=f"CA-DEAD-SELECTOR:{name}:{j}"))
+    for j, col in enumerate(fixed):
+        if not any(col):
+            out.append(Finding(
+                "circuit", "CA-DEAD-FIXED", Severity.WARNING, _CS_FILE, name,
+                f"fixed column {j} is all-zero (dead constants column)",
+                key=f"CA-DEAD-FIXED:{name}:{j}"))
+    return out
+
+
+def audit_context(ctx, cfg: CircuitConfig, name: str,
+                  expressions_fn=all_expressions) -> list:
+    """Run every circuit-audit rule; returns findings in severity order.
+
+    `expressions_fn` exists for the mutation tests: injecting a constraint
+    generator with a seeded over-degree expression must produce CA-DEGREE."""
+    findings = []
+    findings += _audit_cell_references(ctx, name)
+    findings += _audit_degrees(cfg, name, expressions_fn)
+    findings += _audit_tables(ctx, cfg, name)
+    findings += _audit_copy_orphans(ctx, cfg, name)
+    findings += _audit_dead_columns(ctx, cfg, name)
+    findings.sort(key=lambda f: -Severity.ORDER[f.severity])
+    return findings

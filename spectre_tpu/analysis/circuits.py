@@ -1,0 +1,81 @@
+"""Audit targets: the three app circuits at their smallest real shapes.
+
+Each builder returns (ctx, cfg, name) — a fully witness-generated builder
+Context plus the auto-sized CircuitConfig the prover would use. The tiny
+spec (2 validators) keeps witness generation to seconds for the
+committee-update circuit and tens of seconds for the step circuit's BLS
+block; the aggregation target verifies a small k=10 inner snark in-circuit
+(the same shape tests/test_aggregation.py exercises).
+"""
+
+from __future__ import annotations
+
+
+def _tiny():
+    from .. import spec as S
+    return S.TINY
+
+
+def build_committee_update():
+    from ..models import CommitteeUpdateCircuit
+    from ..witness import default_committee_update_args
+    spec = _tiny()
+    args = default_committee_update_args(spec)
+    ctx = CommitteeUpdateCircuit.build_context(args, spec)
+    cfg = ctx.auto_config(k=17,
+                          lookup_bits=CommitteeUpdateCircuit.default_lookup_bits)
+    return ctx, cfg, "committee_update:tiny"
+
+
+def build_sync_step():
+    from ..models import StepCircuit
+    from ..witness import default_sync_step_args
+    spec = _tiny()
+    args = default_sync_step_args(spec)
+    ctx = StepCircuit.build_context(args, spec)
+    # lookup_bits=18 needs k >= 19 for the range table to fit usable rows
+    cfg = ctx.auto_config(k=19, lookup_bits=StepCircuit.default_lookup_bits)
+    return ctx, cfg, "sync_step:tiny"
+
+
+def build_aggregation():
+    import random
+
+    from ..builder.context import Context
+    from ..builder.range_chip import RangeChip
+    from ..models.aggregation import AggregationArgs, AggregationCircuit
+    from ..plonk.keygen import keygen
+    from ..plonk.prover import prove
+    from ..plonk.srs import SRS
+    from ..plonk.transcript import PoseidonTranscript
+
+    # small inner app snark (mirrors tests/test_aggregation.py::inner)
+    random.seed(3)
+    ictx = Context()
+    rng = RangeChip(lookup_bits=8)
+    g = rng.gate
+    a = ictx.load_witness(1234)
+    b = ictx.load_witness(5678)
+    c = g.mul(ictx, a, b)
+    rng.range_check(ictx, a, 16)
+    ictx.expose_public(c)
+    icfg = ictx.auto_config(k=10, lookup_bits=8)
+    iasg = ictx.assignment(icfg)
+    srs = SRS.unsafe_setup(10)
+    pk = keygen(srs, icfg, iasg.fixed, iasg.selectors, iasg.copies)
+    proof = prove(pk, srs, iasg, transcript=PoseidonTranscript())
+
+    args = AggregationArgs(inner_vk=pk.vk, srs=srs,
+                           inner_instances=iasg.instances, proof=proof)
+    spec = _tiny()
+    ctx = AggregationCircuit.build_context(args, spec)
+    cfg = ctx.auto_config(k=15,
+                          lookup_bits=AggregationCircuit.default_lookup_bits)
+    return ctx, cfg, "aggregation:tiny"
+
+
+AUDIT_CIRCUITS = {
+    "committee_update": build_committee_update,
+    "sync_step": build_sync_step,
+    "aggregation": build_aggregation,
+}

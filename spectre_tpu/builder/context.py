@@ -202,6 +202,35 @@ class Context:
     # finalize: streams -> physical columns -> plonk.Assignment
     # ------------------------------------------------------------------
 
+    def cell_references(self) -> dict:
+        """Analysis hook (spectre_tpu.analysis.circuit_audit): per-cell
+        reference metadata for the advice stream. A cell is CONSTRAINED when
+        it sits inside a gated unit (the vertical gate reads all 4 rows) or
+        is an endpoint of a copy constraint / constant pin / lookup push /
+        instance exposure; an ungated cell with no reference is a free
+        witness the proof never binds — the under-constrained bug class.
+
+        Returns {"n_cells", "gated", "referenced"}; the latter two are
+        bytearrays indexed by advice-stream position (1 = covered)."""
+        n = len(self.adv_values)
+        gated = bytearray(n)
+        referenced = bytearray(n)
+        for start, size, is_gated in self.adv_units:
+            if is_gated:
+                gated[start:start + size] = b"\x01" * size
+        for (sa, ia), (sb, ib) in self.copies:
+            if sa == "adv" and 0 <= ia < n:
+                referenced[ia] = 1
+            if sb == "adv" and 0 <= ib < n:
+                referenced[ib] = 1
+        for adv_idx, _row in self.const_uses:
+            if 0 <= adv_idx < n:
+                referenced[adv_idx] = 1
+        for av in self.instance_cells:
+            if av.stream == "adv" and 0 <= av.index < n:
+                referenced[av.index] = 1
+        return {"n_cells": n, "gated": gated, "referenced": referenced}
+
     def stats(self) -> dict:
         return {
             "advice_cells": len(self.adv_values),

@@ -1,0 +1,25 @@
+"""shard_map import/signature compatibility across JAX versions.
+
+Newer JAX exports `jax.shard_map` with a `check_vma` kwarg; 0.4.x ships it
+under `jax.experimental.shard_map` with the older `check_rep` name for the
+same replication-check toggle. The mesh kernels are written against the new
+spelling; this shim rewrites it where needed so one source runs on both.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:
+    from jax import shard_map as _shard_map
+    _NATIVE = True
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _NATIVE = False
+
+
+@functools.wraps(_shard_map)
+def shard_map(*args, **kwargs):
+    if not _NATIVE and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(*args, **kwargs)

@@ -27,6 +27,13 @@ R = bn254.R
 
 ZK_ROWS = 5
 PERM_CHUNK = 2  # columns per permutation grand-product (degree 4 budget)
+# Quotient commitment chunks: the prover commits h as NUM_H_CHUNKS size-n
+# pieces, so deg h <= NUM_H_CHUNKS*n - 4 and every constraint expression
+# must stay within degree NUM_H_CHUNKS + 1 in the column polynomials
+# (CircuitConfig.max_expr_degree). Changing this means changing the proof
+# format: keygen's commitment/query plans, the verifier's Horner fold, the
+# in-circuit verifier, and the EVM codegen all read 3 h-commitments.
+NUM_H_CHUNKS = 3
 
 # ---------------------------------------------------------------------------
 # Wide SHA-256 region (reference: the zkevm "vanilla" SHA circuit wrapped by
@@ -123,6 +130,18 @@ class CircuitConfig:
     @property
     def last_row(self) -> int:
         return self.usable_rows  # l_last index
+
+    @property
+    def max_expr_degree(self) -> int:
+        """Degree budget per constraint expression, counting each column
+        polynomial (advice, fixed, selector, sigma, z, l0/llast/lblind, X)
+        as degree 1: an expression of column-degree d has polynomial degree
+        <= d*(n-1); after dividing by the degree-n vanishing polynomial the
+        quotient must fit the NUM_H_CHUNKS committed chunks, so
+        d <= NUM_H_CHUNKS + 1. Exceeding it makes the prover's quotient
+        division inexact — the bug class the analysis auditor's CA-DEGREE
+        rule catches statically instead of at prove time."""
+        return NUM_H_CHUNKS + 1
 
     @property
     def num_sha_word(self) -> int:

@@ -1,6 +1,7 @@
 """The constraint expressions, written once, evaluated two ways.
 
-`all_expressions(cfg, ctx)` builds the ordered list of constraint values; the
+`all_expressions(cfg, ctx)` yields the ordered STREAM of constraint values
+(a generator — see its docstring for why materializing the list OOMs); the
 prover instantiates ctx over extended-domain evaluation ARRAYS (backend ops),
 the verifier over SCALARS at the challenge point. One definition guarantees
 both sides combine identical polynomials with identical y-powers — the classic

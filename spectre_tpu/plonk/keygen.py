@@ -12,7 +12,8 @@ from dataclasses import dataclass
 
 from ..fields import bn254
 from . import backend as B
-from .constraint_system import CircuitConfig, build_sigma, table_column
+from .constraint_system import (CircuitConfig, NUM_H_CHUNKS, build_sigma,
+                                table_column)
 from .domain import Domain
 from .srs import SRS
 from . import kzg
@@ -76,7 +77,7 @@ class VerifyingKey:
         for j in range(cfg.num_lookup_advice):
             keys.append(("lz", j))
         pre_y = len(keys)
-        for i in range(3):
+        for i in range(NUM_H_CHUNKS):
             keys.append(("h", i))
         return keys, pre_bg, pre_y, len(keys)
 
@@ -150,7 +151,7 @@ class VerifyingKey:
             for s in range(SHA_NUM_SELECTORS):
                 plan.append((("shq", s), 0))
             plan.append((("shk", 0), 0))
-        for i in range(3):
+        for i in range(NUM_H_CHUNKS):
             plan.append((("h", i), 0))
         return plan
 

@@ -16,7 +16,8 @@ from ..fields import bn254
 from ..native import host
 from ..utils.profiling import phase
 from . import backend as B, kzg
-from .constraint_system import Assignment, PERM_CHUNK, permute_lookup
+from .constraint_system import (Assignment, NUM_H_CHUNKS, PERM_CHUNK,
+                                permute_lookup)
 from .domain import DELTA, Domain
 from .expressions import all_expressions, perm_column_keys
 from .keygen import ProvingKey, ROT_LAST
@@ -43,7 +44,8 @@ class _ArrayCtx:
         self.lblind = None
 
     def var(self, key, rot):
-        arr = self._ext[key]
+        # _ext is a mapping (device path) or a callable cache (_quotient_host)
+        arr = self._ext(key) if callable(self._ext) else self._ext[key]
         if rot == 0:
             return arr
         if rot == ROT_LAST:
@@ -288,10 +290,10 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
     # division by the vanishing polynomial was inexact: either the witness
     # violates a constraint, or an expression exceeded the degree-4 budget.
     # Refusing here beats silently emitting an unverifiable proof.
-    assert not np.any(h_coeffs[3 * n:]), \
+    assert not np.any(h_coeffs[NUM_H_CHUNKS * n:]), \
         "quotient not a polynomial: witness violates constraints (or degree budget exceeded)"
     h_chunks = []
-    for i in range(3):
+    for i in range(NUM_H_CHUNKS):
         chunk = h_coeffs[i * n:(i + 1) * n]
         if chunk.shape[0] < n:
             chunk = np.vstack([chunk, np.zeros((n - chunk.shape[0], 4), np.uint64)])
@@ -342,17 +344,40 @@ class _BudgetedExtLRU:
     small circuits stay fully cached, huge ones evict cold families instead
     of dying."""
 
+    # evicted-then-refetched keys past this count = the working set does not
+    # fit the budget and the quotient phase is recomputing 4n NTTs/rolls in
+    # a loop; warn once so the operator knows to raise the budget
+    THRASH_WARN_THRESHOLD = 32
+
     def __init__(self, budget_bytes: int):
         import collections
         self.budget = budget_bytes
         self._d = collections.OrderedDict()
         self._bytes = 0
         self._warned_passthrough = False
+        self._evicted: dict = {}          # key -> times evicted
+        self.recompute_count = 0          # gets of previously-evicted keys
+        self._warned_thrash = False
 
     def get(self, key):
         hit = self._d.get(key)
         if hit is not None:
             self._d.move_to_end(key)
+        elif key in self._evicted:
+            self.recompute_count += 1
+            if (self.recompute_count >= self.THRASH_WARN_THRESHOLD
+                    and not self._warned_thrash):
+                self._warned_thrash = True
+                import sys
+                worst = sorted(self._evicted.items(), key=lambda kv: -kv[1])
+                fams = ", ".join(f"{k[0] if isinstance(k, tuple) else k}"
+                                 f" x{c}" for k, c in worst[:4])
+                print(f"[quotient] extended-array cache thrashing: "
+                      f"{self.recompute_count} recomputes after eviction "
+                      f"(budget {self.budget >> 20} MB; hottest evicted "
+                      f"families: {fams}) — raise SPECTRE_QUOTIENT_CACHE_MB "
+                      f"to avoid repeated 4n NTT/roll recomputes",
+                      file=sys.stderr, flush=True)
         return hit
 
     def put(self, key, arr):
@@ -370,7 +395,8 @@ class _BudgetedExtLRU:
                       f"read recomputes", file=sys.stderr, flush=True)
             return arr
         while self._bytes + arr.nbytes > self.budget and self._d:
-            _, old = self._d.popitem(last=False)
+            old_key, old = self._d.popitem(last=False)
+            self._evicted[old_key] = self._evicted.get(old_key, 0) + 1
             self._bytes -= old.nbytes
         self._d[key] = arr
         self._bytes += arr.nbytes

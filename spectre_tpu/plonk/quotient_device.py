@@ -202,11 +202,13 @@ def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
             return arr
 
     ctx = _DeviceCtx(LazyCols(cols), m, cfg.last_row, mont_scalar)
-    exprs = iter(all_expressions(cfg, ctx, beta, gamma))
-    acc = next(exprs)
     y_m = mont_scalar(y)
-    for e in exprs:
-        acc = h["fold"](acc, y_m, e)
+    acc = None
+    for e in all_expressions(cfg, ctx, beta, gamma):
+        acc = e if acc is None else h["fold"](acc, y_m, e)
+    if acc is None:
+        raise ValueError("config yields no constraint expressions — "
+                         "nothing to fold into a quotient")
     out = h["h_from_acc"](acc, st["vinv"], st["inv_coset"], dom.omega_ext)
     std = h["from_mont"](out)
     return L16.u16limbs_to_u64limbs(np.asarray(std))

@@ -1,0 +1,215 @@
+"""Static-analysis subsystem (spectre_tpu.analysis): finding/baseline
+mechanics, circuit-audit rules, kernel-lint rules — including the seeded
+MUTATION checks: a deliberately under-constrained cell, an over-degree
+expression, and a limb-overflow multiply must each be flagged (the
+auditor's reason to exist is that nothing else notices these)."""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from spectre_tpu.analysis import (Finding, Severity, audit_context,
+                                  load_baseline, partition_findings,
+                                  write_baseline)
+from spectre_tpu.analysis.circuit_audit import expression_degrees
+from spectre_tpu.analysis.kernel_lint import (KERNELS, lint_fn, lint_kernel,
+                                              lint_limbs_host)
+from spectre_tpu.builder.context import Context
+from spectre_tpu.builder.range_chip import RangeChip
+from spectre_tpu.plonk.constraint_system import CircuitConfig
+from spectre_tpu.plonk.expressions import all_expressions
+
+
+def _small_circuit():
+    """A clean little range-checked multiply circuit."""
+    random.seed(0)
+    ctx = Context()
+    rng = RangeChip(lookup_bits=4)
+    g = rng.gate
+    a = ctx.load_witness(3)
+    b = ctx.load_witness(5)
+    c = g.mul(ctx, a, b)
+    rng.range_check(ctx, a, 4)
+    ctx.expose_public(c)
+    cfg = ctx.auto_config(k=7, lookup_bits=4)
+    return ctx, cfg
+
+
+class TestFindings:
+    def test_key_defaults_and_partition(self):
+        f1 = Finding("circuit", "CA-X", Severity.ERROR, "f.py", "obj", "m")
+        assert f1.key == "CA-X:obj"
+        f2 = Finding("circuit", "CA-Y", Severity.WARNING, "f.py", "obj", "m",
+                     key="CA-Y:obj:7")
+        active, suppressed = partition_findings(
+            [f1, f2], {"CA-Y:obj:7": "accepted"})
+        assert active == [f1] and suppressed == [f2]
+
+    def test_baseline_roundtrip(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        f = Finding("kernel", "KL-X", Severity.ERROR, "f.py", "k", "msg",
+                    key="KL-X:k:1")
+        write_baseline([f], path, reason="test")
+        bl = load_baseline(path)
+        assert "KL-X:k:1" in bl
+        with open(path) as fh:
+            assert json.load(fh)["suppressions"][0]["key"] == "KL-X:k:1"
+
+    def test_severity_order(self):
+        assert Severity.at_least("error", "warning")
+        assert not Severity.at_least("warning", "error")
+
+
+class TestCircuitAudit:
+    def test_clean_circuit_has_no_findings(self):
+        ctx, cfg = _small_circuit()
+        assert audit_context(ctx, cfg, "clean") == []
+
+    def test_flags_seeded_underconstrained_cell(self):
+        """THE mutation check: a witness cell no constraint touches."""
+        ctx, cfg = _small_circuit()
+        ctx.load_witness(999)  # assigned, never referenced by anything
+        cfg2 = ctx.auto_config(k=7, lookup_bits=4)
+        rules = [f.rule for f in audit_context(ctx, cfg2, "seeded")]
+        assert "CA-UNDERCONSTRAINED" in rules
+
+    def test_flags_seeded_degree_violation(self):
+        """Injected expression of column-degree 5 > budget 4."""
+        ctx, cfg = _small_circuit()
+
+        def evil(cfg_, c, beta, gamma):
+            yield from all_expressions(cfg_, c, beta, gamma)
+            v = c.var(("adv", 0), 0)
+            yield c.mul(c.mul(c.mul(c.mul(v, v), v), v), v)
+
+        fs = audit_context(ctx, cfg, "deg", expressions_fn=evil)
+        assert any(f.rule == "CA-DEGREE" for f in fs)
+        # the real expression set stays inside the budget
+        assert all(d <= cfg.max_expr_degree for d in expression_degrees(cfg))
+
+    def test_real_expression_degrees_within_budget(self):
+        # incl. the wide-SHA region identities (selector x bit-cubics)
+        cfg = CircuitConfig(k=10, num_advice=1, num_lookup_advice=1,
+                            num_fixed=1, lookup_bits=8, num_sha_slots=1)
+        degs = expression_degrees(cfg)
+        assert degs and max(degs) <= cfg.max_expr_degree
+
+    def test_flags_copy_orphan(self):
+        ctx, cfg = _small_circuit()
+        ctx.copies.append((("adv", 10 ** 6), ("adv", 0)))
+        fs = audit_context(ctx, cfg, "orphan")
+        assert any(f.rule == "CA-COPY-ORPHAN" for f in fs)
+
+    def test_flags_unbound_lookup_table(self):
+        ctx, _ = _small_circuit()
+        ctx.lkp_streams.setdefault("nibble_op", []).append(5)
+        cfg = CircuitConfig(k=7, num_advice=2, num_lookup_advice=1,
+                            num_fixed=1, lookup_bits=4,
+                            lookup_tables=("range",))
+        fs = audit_context(ctx, cfg, "tbl")
+        assert any(f.rule == "CA-TABLE-UNBOUND" for f in fs)
+
+    def test_flags_dead_columns(self):
+        ctx = Context()
+        v = ctx.load_witness(5)
+        ctx.expose_public(v)  # referenced, so not under-constrained
+        cfg = CircuitConfig(k=7, num_advice=1, num_lookup_advice=1,
+                            num_fixed=1, lookup_bits=4)
+        rules = [f.rule for f in audit_context(ctx, cfg, "dead")]
+        assert "CA-DEAD-SELECTOR" in rules and "CA-DEAD-FIXED" in rules
+
+
+class TestKernelLint:
+    def test_flags_seeded_limb_overflow_multiply(self):
+        """THE mutation check: 17-bit limbs leave no headroom in u32."""
+        import jax.numpy as jnp
+        a = jnp.zeros((4, 16), jnp.uint32)
+        fs = lint_fn(lambda x, y: x * y, (a, a), name="mut.widemul",
+                     file="x.py", in_bits=17)
+        assert [f.rule for f in fs] == ["KL-OVERFLOW"]
+        # 16-bit limbs fit exactly: (2^16-1)^2 < 2^32
+        assert lint_fn(lambda x, y: x * y, (a, a), name="mut.mul16",
+                       file="x.py", in_bits=16) == []
+
+    def test_mask_consumed_product_is_exempt(self):
+        import jax.numpy as jnp
+        a = jnp.zeros((4, 16), jnp.uint32)
+        fs = lint_fn(lambda x, y: (x * y) & np.uint32(0xFFFF), (a, a),
+                     name="mut.masked", file="x.py", in_bits=17)
+        assert fs == []
+
+    def test_flags_unreduced_add_chain(self):
+        import jax.numpy as jnp
+        a = jnp.zeros((4, 16), jnp.uint32)
+
+        def chain(x):
+            acc = x
+            for _ in range(17):  # 2^17 summands of 2^16-1 overflow u32
+                acc = acc + acc
+            return acc
+
+        fs = lint_fn(chain, (a,), name="mut.chain", file="x.py", in_bits=16)
+        assert any(f.rule == "KL-OVERFLOW" for f in fs)
+
+    def test_flags_float_in_field_kernel(self):
+        import jax.numpy as jnp
+        a = jnp.zeros((4, 16), jnp.uint32)
+        fs = lint_fn(lambda x: (x.astype(jnp.float32) * 2.0)
+                     .astype(jnp.uint32),
+                     (a,), name="mut.float", file="x.py")
+        assert any(f.rule == "KL-FLOAT" for f in fs)
+
+    def test_flags_host_callback(self):
+        import jax
+        import jax.numpy as jnp
+        a = jnp.zeros((4, 16), jnp.uint32)
+
+        def cb(x):
+            return jax.pure_callback(
+                lambda v: v, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+        fs = lint_fn(cb, (a,), name="mut.cb", file="x.py")
+        assert any(f.rule == "KL-CALLBACK" for f in fs)
+
+    def test_real_field_kernels_clean(self):
+        for spec in KERNELS:
+            if spec.name in ("field_ops.mont_mul", "field_ops.add",
+                             "ntt.ntt", "sha256.compress"):
+                assert lint_kernel(spec) == [], spec.name
+
+    def test_limbs_host_probe_clean(self):
+        assert lint_limbs_host() == []
+
+
+class TestCLI:
+    def test_kernel_engine_exit_clean(self, tmp_path, capsys):
+        from spectre_tpu.analysis.__main__ import main
+        out = str(tmp_path / "findings.json")
+        rc = main(["--engine", "kernel", "--kernels",
+                   "field_ops.add,limbs.host", "--json", out, "-q"])
+        assert rc == 0
+        data = json.load(open(out))
+        assert data["active"] == []
+
+    def test_fail_on_gates_exit_code(self, tmp_path, monkeypatch):
+        """A seeded finding must flip the exit code unless baselined."""
+        from spectre_tpu.analysis import __main__ as M
+        from spectre_tpu.analysis import kernel_lint as KL
+        import jax.numpy as jnp
+
+        def fake_all(names=None):
+            a = jnp.zeros((2, 16), jnp.uint32)
+            return lint_fn(lambda x, y: x * y, (a, a), name="mut.cli",
+                           file="x.py", in_bits=17)
+
+        monkeypatch.setattr(KL, "lint_all_kernels", fake_all)
+        empty = str(tmp_path / "empty.json")
+        rc = M.main(["--engine", "kernel", "--baseline", empty, "-q"])
+        assert rc == 1
+        # accept into a baseline -> clean
+        bl = str(tmp_path / "bl.json")
+        assert M.main(["--engine", "kernel", "--baseline", bl,
+                       "--write-baseline", "-q"]) == 0
+        assert M.main(["--engine", "kernel", "--baseline", bl, "-q"]) == 0
