@@ -1,13 +1,13 @@
 # Build orchestration (reference parity: `justfile` recipes).
 
-.PHONY: all native test test-slow fixtures bench setup-committee setup-step lint lint-fast tpu-evidence
+.PHONY: all native test test-slow fixtures bench bench-fast setup-committee setup-step lint lint-fast tpu-evidence
 
 all: native
 
 native:
 	$(MAKE) -C spectre_tpu/native
 
-test: native lint
+test: native lint bench-fast
 	python -m pytest tests/ -q
 
 test-slow: native
@@ -26,6 +26,12 @@ setup-step:
 
 bench: native
 	python bench.py
+
+# CI perf tier: seconds-scale 2^12 MSM on pinned CPU (no device probing),
+# gated against the checked-in floor in bench_floor.json — fails on a >20%
+# throughput regression so `make test` surfaces perf rot without the 2^16 run
+bench-fast: native
+	python bench.py --fast
 
 # the full hardware-evidence suite, ordered cheap->expensive, every stage
 # deadline-guarded; safe (and labeled) under CPU-JAX when the tunnel is

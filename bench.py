@@ -2,17 +2,30 @@
 """Benchmark entry point: BN254 MSM throughput, TPU vs measured CPU baseline.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "backend": ..., "msm_mode": ..., "impl": ..., "fallback": bool}
 
 The metric is the north star from BASELINE.md: BN254 MSM points/s (the
 dominant prover cost). Baseline = this repo's native C++ single-thread
 Pippenger measured on this machine (the reference Rust prover cannot run here;
-its MSM is the same algorithm on the same hardware class).
+its MSM is the same algorithm on the same hardware class). `backend` and
+`msm_mode` are first-class JSON keys — the metric name is never mangled.
+
+MSM mode: SPECTRE_MSM_MODE if set, else the full `fixed` stack
+(GLV + signed digits + per-SRS precomputed tables, ops/msm.py). The result
+is checked in-run against the native oracle, so a mode bug fails loudly
+instead of producing a fast wrong number.
 
 Resilience (round-1 lesson: the axon tunnel wedged and the bench silently fell
 back to CPU at 0.014x): the device phase runs in a SUBPROCESS with a hard
 deadline — a hung tunnel kills the child, not the benchmark — and is retried
-before a clearly-labeled CPU fallback.
+before a clearly-labeled CPU fallback. SPECTRE_BENCH_PLATFORM skips the
+guesswork: "cpu" goes straight to the pinned-CPU phase (no device attempts,
+no fallback label — r05 burned ~18 min on two doomed device attempts);
+any other value is pinned into the child's JAX_PLATFORMS.
+
+`python bench.py --fast` is the CI tier: 2^12 on pinned CPU, compared
+against the checked-in floor in bench_floor.json (fails on >20% regression).
 """
 
 import json
@@ -23,6 +36,13 @@ import tempfile
 import time
 
 import numpy as np
+
+FLOOR_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "bench_floor.json")
+
+
+def bench_msm_mode() -> str:
+    return os.environ.get("SPECTRE_MSM_MODE", "fixed")
 
 
 def build_points(n: int) -> np.ndarray:
@@ -66,7 +86,10 @@ def device_phase(out_path: str):
 
     logn = int(os.environ.get("BENCH_LOGN", "16"))
     n = 1 << logn
-    c = int(os.environ.get("BENCH_C", "13" if logn >= 18 else "10"))
+    mode = bench_msm_mode()
+    # BENCH_C pins the window size; unset -> the mode's own tuning table
+    c_env = os.environ.get("BENCH_C")
+    c = int(c_env) if c_env else None
     pts64, sc64 = bench_inputs(logn)
 
     ctxq = F.fq_ctx()
@@ -81,18 +104,22 @@ def device_phase(out_path: str):
     def run_aos():
         # NOTE: block_until_ready is not reliable through the axon tunnel;
         # a host transfer (np.asarray) is the only trustworthy sync point.
-        return np.asarray(MSM.combine_windows(MSM.msm_windows(pts, sc16, c), c))
+        # The mode dispatch (vanilla/glv/glv+signed/fixed) lives in MSM.msm;
+        # the fixed-base table is built+cached on the first (untimed) call.
+        return np.asarray(MSM.msm(pts, sc16, c=c, mode=mode,
+                                  base_key=("bench", logn)))
 
     from spectre_tpu.ops import msm_pallas as MP
     _soa_cache = []
 
     def run_soa():
-        # Pallas fused-kernel SoA path; layout conversion cached outside
-        # the timed iterations
+        # Pallas fused-kernel SoA path (vanilla algorithm only); layout
+        # conversion cached outside the timed iterations
+        c_soa = c or (13 if logn >= 18 else 10)
         if not _soa_cache:
             _soa_cache.append(MP.to_soa(pts))
         return np.asarray(MP.combine_windows_soa(
-            MP.msm_windows_soa(_soa_cache[0], sc16, c), c))
+            MP.msm_windows_soa(_soa_cache[0], sc16, c_soa), c_soa))
 
     expect = os.environ.get("BENCH_EXPECT")
 
@@ -104,11 +131,13 @@ def device_phase(out_path: str):
 
     # impl order: the pallas kernel path first on real devices, with the
     # plain-XLA path as in-child fallback (Mosaic availability varies by
-    # backend); BENCH_IMPL=aos|soa pins one.
+    # backend); BENCH_IMPL=aos|soa pins one. The SoA kernel implements the
+    # vanilla algorithm only, so non-vanilla modes pin the AoS path.
     impl_env = os.environ.get("BENCH_IMPL", "auto")
     if impl_env == "soa":
         impls = [("soa", run_soa)]
-    elif impl_env == "aos" or jax.default_backend() == "cpu":
+    elif (impl_env == "aos" or mode != "vanilla"
+          or jax.default_backend() == "cpu"):
         impls = [("aos", run_aos)]
     else:
         impls = [("soa", run_soa), ("aos", run_aos)]
@@ -117,7 +146,7 @@ def device_phase(out_path: str):
     infra_fail = None
     for impl_name, run in impls:
         try:
-            res = run()  # compile + first run
+            res = run()  # compile + first run (+ fixed-base table build)
             if not check(res):
                 mismatch = f"{impl_name}: result mismatch"
                 break      # a wrong result is a correctness regression —
@@ -139,6 +168,8 @@ def device_phase(out_path: str):
             impl_name += "+mxu"    # SPECTRE_FIELD_IMPL=mxu matmul field path
         with open(out_path, "w") as f:
             json.dump({"points_per_s": n / dt, "impl": impl_name,
+                       "msm_mode": mode if impl_name.startswith("aos")
+                       else "vanilla",
                        "backend": jax.default_backend()}, f)
         return
     if mismatch:
@@ -151,7 +182,8 @@ def device_phase(out_path: str):
         raise SystemExit(f"device impls failed: {infra_fail}")
 
 
-def _run_child(force_cpu: bool, expect: str, timeout: float):
+def _run_child(force_cpu: bool, expect: str, timeout: float,
+               platform: str | None = None):
     """Launch the device phase with a hard deadline; returns dict or None."""
     fd, out = tempfile.mkstemp(suffix=".json")
     os.close(fd)
@@ -159,6 +191,10 @@ def _run_child(force_cpu: bool, expect: str, timeout: float):
                BENCH_OUT=out)
     if force_cpu:
         env["BENCH_FORCE_CPU"] = "1"
+    elif platform:
+        # operator-pinned device platform (SPECTRE_BENCH_PLATFORM): no
+        # guessing which backend the ambient sitecustomize resolves to
+        env["JAX_PLATFORMS"] = platform
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                             env=env, stdout=sys.stderr,
                             start_new_session=True)
@@ -200,6 +236,13 @@ def main():
         device_phase(os.environ["BENCH_OUT"])
         return
 
+    fast = "--fast" in sys.argv[1:]
+    if fast:
+        # CI tier: seconds-scale 2^12 MSM on pinned CPU, regression-gated
+        # against the checked-in floor (bench_floor.json)
+        os.environ.setdefault("BENCH_LOGN", "12")
+        os.environ.setdefault("SPECTRE_BENCH_PLATFORM", "cpu")
+
     from spectre_tpu.native import host
 
     logn = int(os.environ.get("BENCH_LOGN", "16"))
@@ -215,32 +258,68 @@ def main():
     baseline = n / cpu_dt
     expect = f"{cpu_res[0]:x},{cpu_res[1]:x}"
 
-    # --- device phase: subprocess w/ hard deadline, retried, then fallback ---
-    dev_timeout = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "540"))
-    suffix = ""
+    # --- device phase: subprocess w/ hard deadline, retried, then fallback.
+    # SPECTRE_BENCH_PLATFORM=cpu skips the device attempts entirely (an
+    # explicit pin, NOT a fallback); any other value is pinned into the
+    # child's JAX_PLATFORMS. r05 lesson: two 540 s device attempts before
+    # the CPU fallback burned ~18 min — the retry budget is now one 240 s
+    # attempt by default (BENCH_DEVICE_TIMEOUT / BENCH_DEVICE_ATTEMPTS). ---
+    platform = os.environ.get("SPECTRE_BENCH_PLATFORM")
+    dev_timeout = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "240"))
+    cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "1200"))
+    fallback = False
     result = None
-    for attempt in range(int(os.environ.get("BENCH_DEVICE_ATTEMPTS", "2"))):
-        result = _run_child(False, expect, dev_timeout)
-        if result:
-            break
-        print(f"# device attempt {attempt + 1} failed/timed out; retrying",
-              file=sys.stderr, flush=True)
+    if platform == "cpu":
+        result = _run_child(True, expect, cpu_timeout)
+    else:
+        for attempt in range(int(os.environ.get("BENCH_DEVICE_ATTEMPTS",
+                                                "1"))):
+            result = _run_child(False, expect, dev_timeout,
+                                platform=platform)
+            if result:
+                break
+            print(f"# device attempt {attempt + 1} failed/timed out",
+                  file=sys.stderr, flush=True)
+        if not result:
+            fallback = True
+            result = _run_child(True, expect, cpu_timeout)
     if not result:
-        suffix = " [device backend unreachable: cpu fallback]"
-        result = _run_child(True, expect,
-                            float(os.environ.get("BENCH_CPU_TIMEOUT", "1200")))
-    if not result:
-        print(json.dumps({"metric": f"bn254_msm_2^{logn} throughput [failed]",
-                          "value": 0, "unit": "points/s", "vs_baseline": 0.0}))
-        return
+        print(json.dumps({"metric": f"bn254_msm_2^{logn} throughput",
+                          "value": 0, "unit": "points/s", "vs_baseline": 0.0,
+                          "backend": None, "msm_mode": bench_msm_mode(),
+                          "impl": None, "fallback": fallback,
+                          "failed": True}))
+        sys.exit(1 if fast else 0)
 
     value = result["points_per_s"]
-    print(json.dumps({
-        "metric": f"bn254_msm_2^{logn} throughput" + suffix,
+    record = {
+        "metric": f"bn254_msm_2^{logn} throughput",
         "value": round(value),
         "unit": "points/s",
         "vs_baseline": round(value / baseline, 3),
-    }))
+        "backend": result.get("backend"),
+        "msm_mode": result.get("msm_mode", bench_msm_mode()),
+        "impl": result.get("impl"),
+        "fallback": fallback,
+    }
+
+    if fast:
+        floor = None
+        if os.path.exists(FLOOR_PATH):
+            with open(FLOOR_PATH) as f:
+                floors = json.load(f)
+            floor = floors.get(f"bn254_msm_2^{logn}_cpu_points_per_s")
+        if floor is not None:
+            record["floor"] = floor
+            record["regression"] = bool(value < 0.8 * floor)
+        print(json.dumps(record))
+        if record.get("regression"):
+            print(f"FAIL: {value:.0f} points/s is >20% below the checked-in "
+                  f"floor {floor} (bench_floor.json)", file=sys.stderr)
+            sys.exit(1)
+        return
+
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -277,10 +277,22 @@ def _eval_eqn(eqn, ei, env, eqns, outvar_set, lint: _Lint, check: bool,
                 k *= eqn.invars[0].aval.shape[d]
         except Exception:
             k = max(eqn.invars[0].aval.size, 1)
+        # MXU accumulation dtype: `preferred_element_type` names the
+        # systolic-array accumulator (int8 x int8 -> int32 on TPU); the
+        # overflow budget is the ACCUMULATOR's, not the operand lanes'.
+        # Absent the param, the output dtype is the accumulator (XLA
+        # accumulates wider internally but wraps on store — which is
+        # exactly the silent-wrap this rule exists to catch).
+        acc_dt = params.get("preferred_element_type")
+        acc_max = _dtype_max(acc_dt) if acc_dt is not None else dmax
+        acc_name = np.dtype(acc_dt).name if acc_dt is not None \
+            else np.dtype(eqn.outvars[0].aval.dtype).name
         true = ins[0] * ins[1] * k
-        _flag(lint, check, eqn, ei, path, env, eqns, outvar_set, true, dmax,
-              f"dot_general accumulating {k} products")
-        return [_cap(min(true, dmax))]
+        _flag(lint, check, eqn, ei, path, env, eqns, outvar_set, true,
+              acc_max,
+              f"dot_general accumulating {k} products in {acc_name} "
+              f"(MXU accumulator)")
+        return [_cap(min(true, acc_max, dmax))]
     if prim == "reduce_sum":
         try:
             k = max(eqn.invars[0].aval.size
@@ -329,6 +341,19 @@ def _eval_eqn(eqn, ei, env, eqns, outvar_set, lint: _Lint, check: bool,
         return [ins[0]]
     if prim in ("max", "min"):
         return [max(ins)] if prim == "max" else [min(ins)]
+    if prim == "abs":
+        # the bound tracks worst-case magnitude, and sub on signed lanes
+        # already returns max(|a|,|b|) — abs preserves that magnitude
+        # (signed-digit MSM: |digit| <= 2^(c-1), not int32 max)
+        return [max(ins)]
+    if prim == "neg":
+        try:
+            if np.issubdtype(np.dtype(eqn.outvars[0].aval.dtype),
+                             np.signedinteger):
+                return [max(ins)]
+        except (AttributeError, TypeError):
+            pass
+        return [dmax]     # unsigned negation wraps
     if prim == "clamp":
         return [min(ins[1], ins[2])]
     if prim in ("eq", "ne", "lt", "le", "gt", "ge", "reduce_and",
@@ -493,6 +518,52 @@ def _build_msm_combine():
     return (lambda w: M.combine_windows.__wrapped__(w, 4)), (wins,)
 
 
+def _build_signed_digits():
+    import jax.numpy as jnp
+    from ..ops import msm as M
+    sc = jnp.asarray(_u32((8, 8)))      # GLV half-scalar magnitudes
+    return (lambda s: M.signed_digit_stream(s, 4, 32)), (sc,)
+
+
+def _build_msm_signed():
+    import jax.numpy as jnp
+    from ..ops import msm as M
+    pts = jnp.asarray(_u32((8, 3, 16)))
+    sc = jnp.asarray(_u32((8, 8)))
+    neg = jnp.zeros(8, dtype=bool)
+    return (lambda p, s, g:
+            M.msm_windows_signed.__wrapped__(p, s, g, 4, 126)), (pts, sc, neg)
+
+
+def _build_msm_fixed():
+    import jax.numpy as jnp
+    from ..ops import msm as M
+    c, nbits, n2 = 8, 126, 4
+    nwin = (nbits + c) // c
+    table = jnp.asarray(_u32((nwin, n2, 3, 16)))
+    sc = jnp.asarray(_u32((n2, 8)))
+    neg = jnp.zeros(n2, dtype=bool)
+    return (lambda t, s, g:
+            M.msm_fixed_run.__wrapped__(t, s, g, c, nbits)), (table, sc, neg)
+
+
+def _build_endo():
+    import jax.numpy as jnp
+    from ..ops import ec as E
+    pts = jnp.asarray(_u32((8, 3, 16)))
+    return (lambda p: E.endo(p)), (pts,)
+
+
+def _build_field_mxu():
+    def build():
+        from ..ops import field_mxu as M
+        from ..ops import field_ops as F
+        ctx = F.fr_ctx()
+        a, b = _field_pair()
+        return (lambda x, y: M.mont_mul(ctx, x, y)), (a, b)
+    return build
+
+
 def _build_poseidon():
     import jax.numpy as jnp
     from ..ops import poseidon as P
@@ -534,6 +605,21 @@ KERNELS = [
     KernelSpec("msm.msm_windows", "spectre_tpu/ops/msm.py", _build_msm),
     KernelSpec("msm.combine_windows", "spectre_tpu/ops/msm.py",
                _build_msm_combine),
+    # GLV / signed-digit / fixed-base MSM entry points (PR 2): the digit
+    # recode carries signed int32 lanes and the window kernels fold sign
+    # masks into point negations — all must stay inside the same value
+    # budgets as the vanilla path
+    KernelSpec("msm.signed_digit_stream", "spectre_tpu/ops/msm.py",
+               _build_signed_digits),
+    KernelSpec("msm.msm_windows_signed", "spectre_tpu/ops/msm.py",
+               _build_msm_signed, in_bits=[16, 16, 1]),
+    KernelSpec("msm.msm_fixed_run", "spectre_tpu/ops/msm.py",
+               _build_msm_fixed, in_bits=[16, 16, 1]),
+    KernelSpec("ec.endo", "spectre_tpu/ops/ec.py", _build_endo),
+    # MXU int8-limb matmul field multiply (shapes stabilized; the
+    # dot_general rule reads its preferred_element_type accumulator)
+    KernelSpec("field_mxu.mont_mul", "spectre_tpu/ops/field_mxu.py",
+               _build_field_mxu()),
     KernelSpec("poseidon.permute", "spectre_tpu/ops/poseidon.py",
                _build_poseidon),
     # SHA-256 u32 lanes are modular BY SPEC (FIPS 180-4): wrap is the

@@ -11,6 +11,8 @@ point; branching is the wrong shape for SIMD lanes).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -110,6 +112,38 @@ def pdbl(p):
 def pneg(p):
     ctx = _fq()
     return jnp.stack([p[..., 0, :], F.neg(ctx, p[..., 1, :]), p[..., 2, :]], axis=-2)
+
+
+def cneg(mask, p):
+    """mask ? -p : p with mask shaped [...] (no point/limb axes).
+
+    One field negation + select — the device half of signed-digit /
+    GLV sign handling (a negated point replaces 2^(c-1)..2^c bucket work,
+    and a negated half-scalar replaces ~127 doublings)."""
+    return select_point(mask, pneg(p), p)
+
+
+@functools.cache
+def _beta_mont():
+    """GLV endomorphism constant beta (cube root of unity in Fq),
+    Montgomery-encoded, as numpy (fresh embedded constant per trace)."""
+    from . import glv
+    return _fq().encode([glv.beta()])[0]
+
+
+def endo(p):
+    """phi(X:Y:Z) = (beta*X : Y : Z), the GLV endomorphism, batched.
+
+    Completeness note: phi maps E to itself (beta^3 = 1 so the curve
+    equation is preserved) and fixes infinity (beta*0 = 0 keeps (0:1:0)),
+    so phi images — like negated points, which also stay on E — remain
+    inside the domain where the RCB complete formulas in `padd` are proven
+    exception-free: a = 0, b = 3, ALL input pairs including doubling,
+    inverses, and the identity. No new case analysis is introduced by the
+    GLV/signed-digit paths."""
+    ctx = _fq()
+    bx = F.mul_const(ctx, p[..., 0, :], jnp.asarray(_beta_mont()))
+    return jnp.stack([bx, p[..., 1, :], p[..., 2, :]], axis=-2)
 
 
 def select_point(mask, a, b):

@@ -250,6 +250,28 @@ def inv(ctx: FieldCtx, a):
     return mont_pow(ctx, a, ctx.p - 2)
 
 
+def limb_digits(scalars, w, c: int):
+    """Extract window-w c-bit digits from [n, L] 16-bit limb tensors.
+
+    Width-generic (L = 16 full scalars, L = 8 GLV half-scalars): the limb
+    count comes from the tensor, not a module constant. w may be a traced
+    int32 (used inside lax loops). Branchless across limb boundaries: a
+    digit spans at most 2 limbs for c <= 16. Windows past the top limb read
+    as zero (the padded-window idiom in parallel.sharded_msm relies on it)."""
+    nlimbs = scalars.shape[-1]
+    off = w * c
+    limb_idx = off // 16
+    shift = off % 16
+    in_range = limb_idx < nlimbs
+    col = jnp.take(scalars, jnp.minimum(limb_idx, nlimbs - 1), axis=1)
+    col = jnp.where(in_range, col, 0)
+    nxt = jnp.take(scalars, jnp.minimum(limb_idx + 1, nlimbs - 1), axis=1)
+    lo = col >> shift
+    hi = jnp.where(shift > 0, nxt << (16 - shift), 0)
+    hi = jnp.where(limb_idx + 1 < nlimbs, hi, 0)
+    return ((lo | hi) & ((1 << c) - 1)).astype(jnp.int32)
+
+
 def is_zero(a):
     return jnp.all(a == 0, axis=-1)
 

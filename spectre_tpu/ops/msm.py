@@ -9,7 +9,7 @@ dependent control flow.
 Per window (processed under `lax` control flow so the graph stays small):
   1. digit extraction from limb scalars (branchless bit windowing)
   2. stable sort of point indices by bucket digit
-  3. segmented halving reduction over the sorted array: at each of log2(n)
+  3. segmented halving reduction over the sorted array: at each of ~log2(n)
      levels adjacent pairs in the same bucket merge (complete projective add);
      pairs straddling a bucket boundary emit their left element into a
      [level, bucket] emission slot — each bucket emits at most once per level,
@@ -24,59 +24,108 @@ Per window (processed under `lax` control flow so the graph stays small):
 
 Complete RCB addition (ops.ec) makes every step branchless; infinity is the
 identity everywhere, so masking = setting slots to (0:1:0).
+
+On top of the vanilla path sit three composable, individually-flagged
+optimizations (`SPECTRE_MSM_MODE`, see `msm_mode()`):
+
+  glv         scalars split k = k1 + k2*lambda via the BN254 cube-root
+              endomorphism (ops.glv, host prep): 2x the points (P and
+              phi(P) = (beta*x, y), one field mul each) but ~127-bit half-
+              scalars — half the window passes. Negative halves become point
+              negations (one field sub).
+  glv+signed  digits recoded on device into [-2^(c-1), 2^(c-1)] (carry scan,
+              branchless): the bucket array and the emission space HALVE
+              (2^(c-1)+1 instead of 2^c); digit signs fold into the same
+              cheap point-negation mask as the GLV signs.
+  fixed       for fixed commitment bases (the KZG SRS): the per-window
+              doubling chains move into a PRECOMPUTED table T[w] = 2^{cw}*B
+              cached per SRS digest (host-side byte-budgeted LRU mirroring
+              the quotient cache in plonk/prover.py). Bucket sums merge
+              ACROSS windows before one weighted aggregation and the final
+              window-combine chain disappears; the reduction itself stays
+              per-window-sized (a flattened nwin*2n mega-reduction measured
+              ~2x slower — see msm_fixed_run). Implies glv+signed.
+
+All modes produce the identical group element (the byteeq harness pins
+byte-identical commitments); they differ only in work shape.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import ec
 from . import field_ops as F
 
 NLIMBS = F.NLIMBS
 
+MSM_MODES = ("vanilla", "glv", "glv+signed", "fixed")
+
+
+def msm_mode() -> str:
+    """Active MSM mode from SPECTRE_MSM_MODE (default: vanilla). Read per
+    call so tests/benches can flip it without reimporting."""
+    mode = os.environ.get("SPECTRE_MSM_MODE", "vanilla")
+    if mode not in MSM_MODES:
+        raise ValueError(
+            f"SPECTRE_MSM_MODE={mode!r}: expected one of {MSM_MODES}")
+    return mode
+
 
 def _digits_traced(scalars, w, c: int):
-    """Extract window-w c-bit digits from [n, 16] 16-bit limb scalars; w may
-    be a traced int32 (used inside lax loops). Branchless across limb
-    boundaries: a digit spans at most 2 limbs for c <= 16."""
-    off = w * c
-    limb_idx = off // 16
-    shift = off % 16
-    col = jnp.take(scalars, limb_idx, axis=1)
-    nxt = jnp.take(scalars, jnp.minimum(limb_idx + 1, NLIMBS - 1), axis=1)
-    lo = col >> shift
-    hi = jnp.where(shift > 0, nxt << (16 - shift), 0)
-    hi = jnp.where(limb_idx + 1 < NLIMBS, hi, 0)
-    return ((lo | hi) & ((1 << c) - 1)).astype(jnp.int32)
+    """Extract window-w c-bit digits from [n, L] 16-bit limb scalars; w may
+    be a traced int32 (used inside lax loops). Width-generic — see
+    field_ops.limb_digits (GLV half-scalars are [n, 8])."""
+    return F.limb_digits(scalars, w, c)
+
+
+def signed_digit_stream(scalars, c: int, nwin: int):
+    """[n, L] limb scalars -> [nwin, n] int32 signed digits in
+    [-2^(c-1)+1, 2^(c-1)], lowest window first.
+
+    Branchless carry recode (lax.scan over windows): a digit above 2^(c-1)
+    becomes d - 2^c with a +1 carry into the next window. Needs
+    nwin >= ceil((nbits+1)/c) so the final carry is always absorbed (the
+    top digit is then <= 2^(c-1) and cannot re-carry)."""
+    half = 1 << (c - 1)
+
+    def step(carry, w):
+        d = F.limb_digits(scalars, w, c) + carry
+        cout = (d > half).astype(jnp.int32)
+        return cout, d - (cout << c)
+
+    _carry, digs = jax.lax.scan(
+        step, jnp.zeros(scalars.shape[0], dtype=jnp.int32), jnp.arange(nwin))
+    return digs
 
 
 def _segmented_bucket_sums(points, digits, nbuckets: int):
     """Sorted segmented reduction -> [nbuckets, 3, 16] bucket sums.
 
     points: [n, 3, 16] projective Montgomery; digits: [n] int32 bucket ids
-    (0 = skip — bucket 0 has weight zero in aggregation)."""
+    (0 = skip — bucket 0 has weight zero in aggregation). Odd level widths
+    append ONE sentinel (bucket id == nbuckets: sorts after every real
+    digit, never merges with one, its emissions are OOB and dropped) instead
+    of padding to a power of two up front — total work stays n + log n
+    instead of up to 2n for awkward sizes (the fixed-base path feeds
+    nwin*2n-sized arrays that are never powers of two)."""
     n = points.shape[0]
     order = jnp.argsort(digits, stable=True)
     buckets = digits[order]
     pts = points[order]
-    # pad to a power of two >= 2 with sentinel bucket id == nbuckets: sorts
-    # after every real digit, never merges with one (emissions to it are OOB
-    # and dropped), so correctness is unaffected.
-    n_pad = max(1 << ((n - 1).bit_length() if n > 1 else 1), 2)
-    if n_pad != n:
-        pts = jnp.concatenate([pts, ec.inf_point((n_pad - n,))], axis=0)
-        buckets = jnp.concatenate(
-            [buckets, jnp.full((n_pad - n,), nbuckets, dtype=buckets.dtype)])
-    n = n_pad
-    levels = n.bit_length() - 1
+    levels = (n - 1).bit_length()
 
     emissions = ec.inf_point((levels + 1, nbuckets))
     for lvl in range(levels):
-        m = pts.shape[0]
+        if pts.shape[0] % 2:
+            pts = jnp.concatenate([pts, ec.inf_point((1,))], axis=0)
+            buckets = jnp.concatenate(
+                [buckets, jnp.full((1,), nbuckets, dtype=buckets.dtype)])
         left, right = pts[0::2], pts[1::2]
         bl, br = buckets[0::2], buckets[1::2]
         same = bl == br
@@ -93,7 +142,6 @@ def _segmented_bucket_sums(points, digits, nbuckets: int):
 
     # tree-reduce emissions over the level axis
     acc = emissions
-    total_levels = levels + 1
     while acc.shape[0] > 1:
         k = acc.shape[0]
         half = k // 2
@@ -106,7 +154,8 @@ def _segmented_bucket_sums(points, digits, nbuckets: int):
 def _aggregate_buckets(bucket_sums, c: int):
     """sum_b b * B_b for each window via bit decomposition.
 
-    bucket_sums: [nwin, nbuckets, 3, 16] -> [nwin, 3, 16]."""
+    bucket_sums: [nwin, nbuckets, 3, 16] -> [nwin, 3, 16]. nbuckets may be
+    any size with ids < 2^c (the signed paths pass 2^(c-1)+1)."""
     nwin, nbuckets = bucket_sums.shape[0], bucket_sums.shape[1]
     idx = jnp.arange(nbuckets)
     # [nwin, c, nbuckets, 3, 16] masked by bit j of the bucket index
@@ -129,6 +178,18 @@ def _aggregate_buckets(bucket_sums, c: int):
     return acc
 
 
+def _msm_windows_impl(points, scalars, c: int, nbits: int):
+    nwin = (nbits + c - 1) // c
+    nbuckets = 1 << c
+
+    def one_window(w):
+        d = F.limb_digits(scalars, w, c)
+        return _segmented_bucket_sums(points, d, nbuckets)
+
+    bucket_sums = jax.lax.map(one_window, jnp.arange(nwin))  # [nwin, nb, 3, 16]
+    return _aggregate_buckets(bucket_sums, c)
+
+
 @functools.partial(jax.jit, static_argnums=(2,))
 def msm_windows(points, scalars, c: int):
     """Per-window partial MSM sums: [nwin, 3, 16].
@@ -136,14 +197,33 @@ def msm_windows(points, scalars, c: int):
     points: [n, 3, 16] projective Montgomery; scalars: [n, 16] standard-form
     16-bit limbs. Separated from the final combine so the window axis can be
     sharded across devices (parallel.sharded_msm all-reduces these)."""
-    nwin = (254 + c - 1) // c
-    nbuckets = 1 << c
+    return _msm_windows_impl(points, scalars, c, 254)
 
-    def one_window(w):
-        d = _digits_traced(scalars, w, c)
-        return _segmented_bucket_sums(points, d, nbuckets)
 
-    bucket_sums = jax.lax.map(one_window, jnp.arange(nwin))  # [nwin, nb, 3, 16]
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def msm_windows_bits(points, scalars, c: int, nbits: int):
+    """msm_windows for scalars of a declared bit-length (GLV half-scalars:
+    nbits = glv.glv_bits(), scalars [n, 8])."""
+    return _msm_windows_impl(points, scalars, c, nbits)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def msm_windows_signed(points, scalars, neg, c: int, nbits: int):
+    """Signed-digit window phase: [nwin, 3, 16] partial sums.
+
+    scalars: [n, L] limb magnitudes; neg: [n] bool per-point sign (the GLV
+    half-scalar signs). Digit signs and point signs fold into ONE negation
+    mask per window — negation is a single field subtract, so the halved
+    bucket array (2^(c-1)+1) is nearly free."""
+    nwin = (nbits + c) // c          # ceil((nbits + 1) / c): room for carry
+    nbuckets = (1 << (c - 1)) + 1
+    digs = signed_digit_stream(scalars, c, nwin)
+
+    def one_window(s):
+        eff = ec.cneg((s < 0) ^ neg, points)
+        return _segmented_bucket_sums(eff, jnp.abs(s), nbuckets)
+
+    bucket_sums = jax.lax.map(one_window, digs)
     return _aggregate_buckets(bucket_sums, c)
 
 
@@ -160,9 +240,191 @@ def combine_windows(window_sums, c: int):
     return jax.lax.fori_loop(0, nwin, body, ec.inf_point(()))
 
 
-def default_window(n: int) -> int:
-    # c > 13 OOMs in _aggregate_buckets (the bit-decomposition select
-    # materializes [nwin, c, 2^c, 3, 16]); 13 is the practical ceiling.
+# ---------------------------------------------------------------------------
+# GLV expansion (device side; host scalar prep lives in ops.glv)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _expand_endo(points):
+    """[n, 3, 16] -> [2n, 3, 16]: [P ; phi(P)], phi the GLV endomorphism."""
+    return jnp.concatenate([points, ec.endo(points)], axis=0)
+
+
+@jax.jit
+def _apply_sign(points, neg):
+    return ec.cneg(neg, points)
+
+
+def glv_split(points, scalars):
+    """Host+device GLV prep: (points2 [2n,3,16], sc2 [2n,8], neg [2n]).
+
+    points2 = [P ; phi(P)] WITHOUT signs applied — the signed-digit kernel
+    folds `neg` into its digit-sign mask; the unsigned path applies it with
+    `_apply_sign` once."""
+    from . import glv
+    a1, a2, n1, n2 = glv.decompose_limbs16(np.asarray(scalars))
+    pts2 = _expand_endo(points)
+    sc2 = jnp.asarray(np.concatenate([a1, a2], axis=0))
+    neg = jnp.asarray(np.concatenate([n1, n2], axis=0))
+    return pts2, sc2, neg
+
+
+# ---------------------------------------------------------------------------
+# fixed-base tables (per-SRS precompute, host-side budgeted LRU)
+# ---------------------------------------------------------------------------
+
+class _TableLRU:
+    """Byte-budgeted LRU over fixed-base window tables (OOM guard).
+
+    Mirrors the quotient-phase `_BudgetedExtLRU` (plonk/prover.py): every
+    entry is pure DERIVED data — a doubling-chain expansion of a base the
+    caller still holds — so eviction costs recompute time, never
+    correctness. A 2^16-point GLV table at c=13 is ~252 MB; an unbounded
+    cache across several SRS sizes would quietly eat the prover's memory
+    pool. Budget: SPECTRE_MSM_TABLE_MB, default min(8 GB, 25% of MemTotal).
+    Entries hold a strong ref to the base object so id-derived keys can
+    never alias a recycled array."""
+
+    def __init__(self, budget_bytes: int):
+        import collections
+        self.budget = budget_bytes
+        self._d = collections.OrderedDict()   # key -> (base_ref, table)
+        self._bytes = 0
+        self.hits = 0
+        self.builds = 0
+
+    def get(self, key, base):
+        hit = self._d.get(key)
+        if hit is not None and (hit[0] is None or hit[0] is base):
+            self._d.move_to_end(key)
+            self.hits += 1
+            return hit[1]
+        return None
+
+    def put(self, key, base, table):
+        nbytes = table.size * table.dtype.itemsize
+        self.builds += 1
+        if nbytes > self.budget:
+            import sys
+            print(f"[msm] fixed-base table ({nbytes >> 20} MB) exceeds "
+                  f"SPECTRE_MSM_TABLE_MB budget ({self.budget >> 20} MB): "
+                  f"uncached — every fixed-mode MSM rebuilds the doubling "
+                  f"chain", file=sys.stderr, flush=True)
+            return table
+        while self._bytes + nbytes > self.budget and self._d:
+            _k, (_ref, old) = self._d.popitem(last=False)
+            self._bytes -= old.size * old.dtype.itemsize
+        self._d[key] = (base, table)
+        self._bytes += nbytes
+        return table
+
+
+def _table_budget_bytes() -> int:
+    mb = os.environ.get("SPECTRE_MSM_TABLE_MB")
+    if mb is not None:
+        return int(mb) << 20
+    try:
+        with open("/proc/meminfo") as f:
+            total = int(f.readline().split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        return 8 << 30
+    return min(8 << 30, int(total * 0.25))
+
+
+_TABLES = _TableLRU(_table_budget_bytes())
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _build_window_table(points, c: int, nwin: int):
+    """[nwin, n, 3, 16] with T[w] = 2^{cw} * points, by chained doubling
+    (c doublings per window step; the last step skips its chain — T[nwin]
+    is never read)."""
+    def step(cur, w):
+        def dbl_chain(p):
+            return jax.lax.fori_loop(0, c, lambda _i, q: ec.padd(q, q), p)
+        nxt = jax.lax.cond(w < nwin - 1, dbl_chain, lambda p: p, cur)
+        return nxt, cur
+
+    _last, tables = jax.lax.scan(step, points, jnp.arange(nwin))
+    return tables
+
+
+def fixed_base_table(points, c: int, nwin: int, base_key=None):
+    """[nwin, 2n, 3, 16] GLV fixed-base table, LRU-cached: T[w] holds
+    2^{cw} * [P ; phi(P)].
+
+    The doubling chains run on the P half only — phi commutes with
+    doubling, so the endomorphism half is one field multiply per entry
+    instead of a second chain. base_key (e.g. the SRS digest) names the
+    base stably across processes/encodings; without it the cache keys on
+    id(points) with a strong ref pin."""
+    n = points.shape[0]
+    key = (base_key if base_key is not None else ("id", id(points)),
+           int(n), int(c), int(nwin))
+    ref = None if base_key is not None else points
+    hit = _TABLES.get(key, ref)
+    if hit is not None:
+        return hit
+    tab = _build_window_table(points, c, nwin)            # [nwin, n, 3, 16]
+    tab = jnp.concatenate([tab, ec.endo(tab)], axis=1)    # [nwin, 2n, 3, 16]
+    return _TABLES.put(key, ref, tab)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def msm_fixed_run(table, scalars, neg, c: int, nbits: int):
+    """Fixed-base MSM over a precomputed window table. Returns [3, 16].
+
+    table: [nwin, N, 3, 16] from fixed_base_table; scalars: [N, L] half-
+    scalar magnitudes; neg: [N] bool signs. Three structural savings over
+    the dynamic-base signed path: no per-window doubling work (the table
+    pre-shifts the base), bucket sums MERGE ACROSS WINDOWS before the
+    weighted aggregation (one aggregation pass instead of nwin — sound
+    because weight b is window-independent once bases carry 2^{cw}), and
+    the final combine chain disappears entirely. The reduction stays
+    per-window-sized: a single nwin*N mega-reduction measured ~2x slower
+    per element on CPU (the 250 MB working set falls out of cache; the
+    ~25 MB window slices stream)."""
+    nwin = (nbits + c) // c
+    nbuckets = (1 << (c - 1)) + 1
+    digs = signed_digit_stream(scalars, c, nwin)          # [nwin, N]
+
+    def one_window(args):
+        tw, s = args
+        eff = ec.cneg((s < 0) ^ neg, tw)
+        return _segmented_bucket_sums(eff, jnp.abs(s), nbuckets)
+
+    bucket_sums = jax.lax.map(one_window, (table, digs))  # [nwin, nb, 3, 16]
+    # cross-window bucket merge: tree-fold the window axis
+    acc = bucket_sums
+    while acc.shape[0] > 1:
+        k = acc.shape[0]
+        half = k // 2
+        merged = ec.padd(acc[:half], acc[half:2 * half])
+        acc = jnp.concatenate([merged, acc[2 * half:]], axis=0) \
+            if k % 2 else merged
+    return _aggregate_buckets(acc, c)[0]
+
+
+# ---------------------------------------------------------------------------
+# window-size tuning + top-level dispatch
+# ---------------------------------------------------------------------------
+
+def default_window(n: int, signed: bool = False) -> int:
+    """Pippenger window size for n points (the EXPANDED count under GLV).
+
+    c > 13 OOMs in _aggregate_buckets (the bit-decomposition select
+    materializes [nwin, c, nbuckets, 3, 16]); 13 is the practical ceiling.
+    With signed digits the bucket array is 2^(c-1)+1 — the aggregation and
+    emission terms that cap c relax by one bucket-doubling, so each size
+    class affords a larger window (pinned by tests/test_msm_modes.py)."""
+    if signed:
+        if n >= 1 << 18:
+            return 13
+        if n >= 1 << 12:
+            return 11
+        if n >= 1 << 7:
+            return 8
+        return 5
     if n >= 1 << 18:
         return 13
     if n >= 1 << 12:
@@ -172,13 +434,55 @@ def default_window(n: int) -> int:
     return 4
 
 
-def msm(points, scalars, c: int | None = None):
-    """Full MSM on one device. points [n,3,16] proj Montgomery (ec.encode_points),
-    scalars [n,16] standard limbs (limbs.ints_to_limbs16). Returns [3,16]."""
+def default_window_fixed(n: int) -> int:
+    """Window size for the fixed-base path (n = expanded point count).
+
+    The reduction shape matches the signed path window-for-window (the
+    table removes doubling/combine work, not reduction work), so the
+    signed tuning table applies; table MEMORY scales with nwin*n, which
+    the larger signed windows also help."""
+    return default_window(n, signed=True)
+
+
+def msm(points, scalars, c: int | None = None, mode: str | None = None,
+        base_key=None):
+    """Full MSM on one device. points [n,3,16] proj Montgomery
+    (ec.encode_points), scalars [n,16] standard limbs
+    (limbs.ints_to_limbs16). Returns [3,16].
+
+    mode defaults to SPECTRE_MSM_MODE (msm_mode()); base_key names a fixed
+    base (SRS digest) for the fixed-mode table cache."""
+    mode = mode if mode is not None else msm_mode()
+    if mode not in MSM_MODES:
+        raise ValueError(f"unknown MSM mode {mode!r}")
     n = points.shape[0]
-    if c is None:
-        c = default_window(n)
-    return combine_windows(msm_windows(points, scalars, c), c)
+    if mode == "vanilla":
+        if c is None:
+            c = default_window(n)
+        return combine_windows(msm_windows(points, scalars, c), c)
+
+    from . import glv
+    nbits = glv.glv_bits()
+    if mode == "fixed":
+        if c is None:
+            c = default_window_fixed(2 * n)
+        nwin = (nbits + c) // c
+        a1, a2, n1, n2 = glv.decompose_limbs16(np.asarray(scalars))
+        sc2 = jnp.asarray(np.concatenate([a1, a2], axis=0))
+        neg = jnp.asarray(np.concatenate([n1, n2], axis=0))
+        table = fixed_base_table(points, c, nwin, base_key=base_key)
+        return msm_fixed_run(table, sc2, neg, c, nbits)
+
+    pts2, sc2, neg = glv_split(points, scalars)
+    if mode == "glv":
+        if c is None:
+            c = default_window(2 * n)
+        wins = msm_windows_bits(_apply_sign(pts2, neg), sc2, c, nbits)
+    else:  # glv+signed
+        if c is None:
+            c = default_window(2 * n, signed=True)
+        wins = msm_windows_signed(pts2, sc2, neg, c, nbits)
+    return combine_windows(wins, c)
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
@@ -194,10 +498,47 @@ def msm_windows_batch(points, scalars_batch, c: int):
     return jax.vmap(lambda sc: msm_windows.__wrapped__(points, sc, c))(scalars_batch)
 
 
-def msm_batch(points, scalars_batch, c: int | None = None):
-    """[m] results (projective [m, 3, 16]) for m scalar vectors."""
+def msm_batch(points, scalars_batch, c: int | None = None,
+              mode: str | None = None, base_key=None):
+    """[m] results (projective [m, 3, 16]) for m scalar vectors.
+
+    Non-vanilla modes run the rows SEQUENTIALLY through the single-MSM
+    kernels (the measured-faster single-chip shape — see msm_windows_batch)
+    with the GLV expansion / fixed table shared across rows; the mesh-
+    parallel batch axis lives in parallel.batch_msm."""
+    mode = mode if mode is not None else msm_mode()
     n = points.shape[0]
+    if mode == "vanilla":
+        if c is None:
+            c = default_window(n)
+        wins = msm_windows_batch(points, scalars_batch, c)
+        return jax.vmap(lambda w: combine_windows.__wrapped__(w, c))(wins)
+
+    from . import glv
+    nbits = glv.glv_bits()
+    outs = []
+    if mode == "fixed":
+        if c is None:
+            c = default_window_fixed(2 * n)
+        nwin = (nbits + c) // c
+        table = fixed_base_table(points, c, nwin, base_key=base_key)
+        for sc in scalars_batch:
+            a1, a2, n1, n2 = glv.decompose_limbs16(np.asarray(sc))
+            sc2 = jnp.asarray(np.concatenate([a1, a2], axis=0))
+            neg = jnp.asarray(np.concatenate([n1, n2], axis=0))
+            outs.append(msm_fixed_run(table, sc2, neg, c, nbits))
+        return jnp.stack(outs)
+
+    pts2 = _expand_endo(points)
     if c is None:
-        c = default_window(n)
-    wins = msm_windows_batch(points, scalars_batch, c)
-    return jax.vmap(lambda w: combine_windows.__wrapped__(w, c))(wins)
+        c = default_window(2 * n, signed=(mode == "glv+signed"))
+    for sc in scalars_batch:
+        a1, a2, n1, n2 = glv.decompose_limbs16(np.asarray(sc))
+        sc2 = jnp.asarray(np.concatenate([a1, a2], axis=0))
+        neg = jnp.asarray(np.concatenate([n1, n2], axis=0))
+        if mode == "glv":
+            wins = msm_windows_bits(_apply_sign(pts2, neg), sc2, c, nbits)
+        else:
+            wins = msm_windows_signed(pts2, sc2, neg, c, nbits)
+        outs.append(combine_windows(wins, c))
+    return jnp.stack(outs)

@@ -70,16 +70,54 @@ def _runner(mesh: Mesh, c: int):
     return fn
 
 
+def _runner_glv(mesh: Mesh, c: int, nbits: int, signed: bool):
+    """GLV-prepped variant: scalars are half-scalar magnitudes riding with a
+    per-row sign mask; `signed` picks the signed-digit window kernel (sign
+    folded into the digit mask) vs point-level negation + unsigned windows."""
+    key = (_mesh_key(mesh), c, nbits, signed)
+    fn = _runner_cache.get(key)
+    if fn is None:
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(None, None, None), P("batch", None, None),
+                      P("batch", None)),
+            out_specs=P("batch", None, None),
+            check_vma=False,
+        )
+        def run(p, sc, ng):
+            def one(args):
+                s, g = args
+                if signed:
+                    wins = MSM.msm_windows_signed.__wrapped__(
+                        p, s, g, c, nbits)
+                else:
+                    wins = MSM._msm_windows_impl(
+                        MSM._apply_sign.__wrapped__(p, g), s, c, nbits)
+                return MSM.combine_windows.__wrapped__(wins, c)
+
+            return jax.lax.map(one, (sc, ng))
+
+        fn = jax.jit(run)
+        _runner_cache[key] = fn
+    return fn
+
+
 def batch_msm_dp(points, scalars_batch, c: int | None = None,
-                 mesh: Mesh | None = None):
+                 mesh: Mesh | None = None, neg_batch=None, nbits: int = 254,
+                 signed: bool = False):
     """points [n,3,16] projective Montgomery (replicated); scalars_batch
-    [B,n,16] standard limbs. Returns [B,3,16] projective results.
+    [B,n,L] standard limbs. Returns [B,3,16] projective results.
 
     B is padded to a multiple of the mesh size with zero scalar vectors
-    (their MSM is the identity; padding is dropped before returning)."""
+    (their MSM is the identity; padding is dropped before returning).
+
+    GLV threading (backend.msm_many): pass the endomorphism-EXPANDED base,
+    half-scalar magnitudes (L=8), `neg_batch` [B,n] sign masks, and
+    nbits=glv.glv_bits(); signed=True routes through the signed-digit
+    kernels (halved buckets)."""
     n = points.shape[0]
     if c is None:
-        c = MSM.default_window(n)
+        c = MSM.default_window(n, signed=signed)
     mesh = mesh or _batch_mesh()
     ndev = mesh.shape["batch"]
     b = scalars_batch.shape[0]
@@ -89,8 +127,17 @@ def batch_msm_dp(points, scalars_batch, c: int | None = None,
             [jnp.asarray(scalars_batch),
              jnp.zeros((pad,) + scalars_batch.shape[1:],
                        dtype=scalars_batch.dtype)])
+        if neg_batch is not None:
+            neg_batch = jnp.concatenate(
+                [jnp.asarray(neg_batch),
+                 jnp.zeros((pad,) + neg_batch.shape[1:], dtype=bool)])
     sb = jax.device_put(jnp.asarray(scalars_batch),
                         NamedSharding(mesh, P("batch", None, None)))
     pts = _replicated_base(points, mesh)
-    out = _runner(mesh, c)(pts, sb)
+    if neg_batch is None:
+        out = _runner(mesh, c)(pts, sb)
+    else:
+        ngb = jax.device_put(jnp.asarray(neg_batch),
+                             NamedSharding(mesh, P("batch", None)))
+        out = _runner_glv(mesh, c, nbits, signed)(pts, sb, ngb)
     return out[:b]

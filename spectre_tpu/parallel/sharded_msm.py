@@ -32,34 +32,67 @@ def _fold_points(stacked):
     return acc[0]
 
 
-def sharded_msm(points, scalars, c: int, mesh: Mesh):
+def sharded_msm(points, scalars, c: int, mesh: Mesh, nbits: int = 254,
+                signed: bool = False, neg=None):
     """MSM over a ("data", "win") mesh.
 
-    points [n, 3, 16] projective Montgomery, scalars [n, 16] standard limbs;
-    n must divide evenly by the data-axis size. Returns a replicated [3, 16]
-    projective result."""
-    nwin = (254 + c - 1) // c
+    points [n, 3, 16] projective Montgomery, scalars [n, L] standard limbs
+    (L=16 full scalars, L=8 GLV half-scalar magnitudes with nbits set to
+    glv.glv_bits()); n must divide evenly by the data-axis size. Returns a
+    replicated [3, 16] projective result.
+
+    The GLV scalar-prep stage happens BEFORE sharding (backend._msm_sharded:
+    host decomposition, endomorphism expansion, sign handling), so rows here
+    are already aligned (point, scalar[, sign]) triples and the data axis
+    shards them uniformly. signed=True runs the signed-digit recode PER
+    SHARD (each shard holds whole scalars, so the carry chain never crosses
+    a shard boundary) with `neg` [n] bool sign masks folded into the digit
+    signs; buckets halve to 2^(c-1)+1."""
+    nwin = (nbits + c) // c if signed else (nbits + c - 1) // c
     n_win_shards = mesh.shape["win"]
     # pad the window count so it shards evenly; extra windows read digit bits
-    # beyond 254 which are always zero -> contribute infinity, harmless.
+    # beyond nbits which are always zero -> contribute infinity, harmless.
     nwin_padded = ((nwin + n_win_shards - 1) // n_win_shards) * n_win_shards
+    nbuckets = (1 << (c - 1)) + 1 if signed else 1 << c
+
+    in_specs = [P("data", None, None), P("data", None)]
+    args = [points, scalars]
+    if signed:
+        in_specs.append(P("data"))
+        args.append(neg if neg is not None
+                    else jnp.zeros(points.shape[0], dtype=bool))
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(P("data", None, None), P("data", None)),
+        in_specs=tuple(in_specs),
         out_specs=P(None, None, None),
         check_vma=False,  # scan carries start as unvarying constants (vma mismatch)
     )
-    def windows_phase(pts, sc):
+    def windows_phase(pts, sc, *rest):
         widx = jax.lax.axis_index("win")
         nloc = nwin_padded // n_win_shards
 
-        def one_window(i):
-            w = widx * nloc + i
-            d = MSM._digits_traced(sc, w, c)
-            # mask digits for windows beyond the real count
-            d = jnp.where(w < nwin, d, 0)
-            return MSM._segmented_bucket_sums(pts, d, 1 << c)
+        if signed:
+            ng = rest[0]
+            digs = MSM.signed_digit_stream(sc, c, nwin)   # [nwin, n_local]
+            if nwin_padded > nwin:
+                digs = jnp.concatenate(
+                    [digs, jnp.zeros((nwin_padded - nwin,) + digs.shape[1:],
+                                     dtype=digs.dtype)])
+            local_digs = jax.lax.dynamic_slice_in_dim(
+                digs, widx * nloc, nloc, axis=0)
+
+            def one_window(i):
+                s = local_digs[i]
+                eff = ec.cneg((s < 0) ^ ng, pts)
+                return MSM._segmented_bucket_sums(eff, jnp.abs(s), nbuckets)
+        else:
+            def one_window(i):
+                w = widx * nloc + i
+                d = MSM._digits_traced(sc, w, c)
+                # mask digits for windows beyond the real count
+                d = jnp.where(w < nwin, d, 0)
+                return MSM._segmented_bucket_sums(pts, d, nbuckets)
 
         bucket_sums = jax.lax.map(one_window, jnp.arange(nloc))
         local = MSM._aggregate_buckets(bucket_sums, c)     # [nloc, 3, 16]
@@ -73,7 +106,7 @@ def sharded_msm(points, scalars, c: int, mesh: Mesh):
     # jit the SPMD program: eager shard_map calls bypass the persistent
     # compile cache, which made every dryrun/bench pay the full multi-minute
     # XLA CPU compile (round-1 MULTICHIP timeout)
-    window_sums = jax.jit(windows_phase)(points, scalars)[:nwin]
+    window_sums = jax.jit(windows_phase)(*args)[:nwin]
     return MSM.combine_windows(window_sums, c)
 
 

@@ -131,13 +131,16 @@ class CpuBackend:
         return host.fp_scale_batch(host.FR, data, pow(n, -1, R))
 
     # -- MSM: points [m, 8] u64 affine standard, scalars [m, 4] --
-    def msm(self, points, scalars):
+    def msm(self, points, scalars, base_key=None):
+        # base_key names a fixed base for the device table cache; the
+        # native Pippenger has no precompute path, so it is ignored here
         m = min(points.shape[0], scalars.shape[0])
         return host.g1_msm(points[:m], scalars[:m])
 
-    def msm_many(self, points, scalars_list):
+    def msm_many(self, points, scalars_list, base_key=None):
         """Commit several scalar vectors against the same base points."""
-        return [self.msm(points, sc) for sc in scalars_list]
+        return [self.msm(points, sc, base_key=base_key)
+                for sc in scalars_list]
 
 
 class TpuBackend(CpuBackend):
@@ -210,7 +213,7 @@ class TpuBackend(CpuBackend):
     # via SPECTRE_SHARD_MSM_MIN_LOGN)
     SHARD_MSM_MIN_LOGN = 20
 
-    def msm(self, points, scalars):
+    def msm(self, points, scalars, base_key=None):
         import jax
         import jax.numpy as jnp
 
@@ -221,42 +224,79 @@ class TpuBackend(CpuBackend):
             return self._msm_sharded(points, scalars, m)
         pts = self._base_points(points, m)
         sc16 = jnp.asarray(L16.u64limbs_to_u16limbs(scalars[:m]))
-        res = MSM.msm(pts, sc16)
+        res = MSM.msm(pts, sc16, base_key=base_key)
         out = ec.decode_points(res[None])[0]
         return out
 
     def _msm_sharded(self, points, scalars, m: int):
         """One MSM sharded over the ("data", "win") mesh. Points are padded
-        with infinity (zero scalars) so the data axis divides evenly."""
+        with infinity (zero scalars) so the data axis divides evenly.
+
+        GLV modes ride the mesh too: the scalar-prep stage (host
+        decomposition + device endomorphism expansion) runs BEFORE
+        device_put, so each data shard holds aligned (point, half-scalar,
+        sign) rows. `fixed` degrades to glv+signed here — the flattened
+        table layout and the data-axis sharding disagree, and a sharded MSM
+        is the huge-single-MSM case where table residency per device is the
+        scarce resource anyway."""
         import jax.numpy as jnp
 
-        from ..ops import ec, limbs as L16
+        from ..ops import ec, limbs as L16, msm as MSM
         from ..parallel.mesh import default_mesh
         from ..parallel.sharded_msm import shard_points, sharded_msm
 
+        mode = MSM.msm_mode()
         mesh = default_mesh()
         ndata = mesh.shape["data"]
-        mp = ((m + ndata - 1) // ndata) * ndata
         pts = self._base_points(points, m)
-        if mp > m:
+        sc16 = L16.u64limbs_to_u16limbs(scalars[:m])
+        nbits, signed = 254, False
+        if mode != "vanilla":
+            from ..ops import glv
+            a1, a2, n1, n2 = glv.decompose_limbs16(sc16)
+            pts = MSM._expand_endo(pts)
+            sc16 = np.concatenate([a1, a2], axis=0)
+            neg_np = np.concatenate([n1, n2], axis=0)
+            nbits = glv.glv_bits()
+            signed = mode in ("glv+signed", "fixed")
+            if not signed:
+                pts = MSM._apply_sign(pts, jnp.asarray(neg_np))
+                neg_np = np.zeros_like(neg_np)
+            m2 = 2 * m
+        else:
+            neg_np = np.zeros(m, dtype=bool)
+            m2 = m
+        mp = ((m2 + ndata - 1) // ndata) * ndata
+        if mp > m2:
             from ..ops import field_ops as Fo
-            inf = jnp.zeros((mp - m, 3, 16), dtype=jnp.uint32)
+            inf = jnp.zeros((mp - m2, 3, 16), dtype=jnp.uint32)
             # RCB identity (0:1:0), y in Montgomery form
             inf = inf.at[:, 1].set(jnp.asarray(Fo.fq_ctx().one_mont))
             pts = jnp.concatenate([pts, inf], axis=0)
-        sc = np.zeros((mp, 16), dtype=np.uint32)
-        sc[:m] = np.asarray(L16.u64limbs_to_u16limbs(scalars[:m]))
+        sc = np.zeros((mp, sc16.shape[1]), dtype=np.uint32)
+        sc[:m2] = sc16
+        ng = np.zeros(mp, dtype=bool)
+        ng[:m2] = neg_np
         pd, sd = shard_points(pts, jnp.asarray(sc), mesh)
-        c = 13 if mp >= (1 << 18) else 10
-        res = sharded_msm(pd, sd, c, mesh)
+        if mode == "vanilla":
+            c = 13 if mp >= (1 << 18) else 10
+        else:
+            c = MSM.default_window(mp, signed=signed)
+        res = sharded_msm(pd, sd, c, mesh, nbits=nbits, signed=signed,
+                          neg=jnp.asarray(ng) if signed else None)
         return ec.decode_points(np.asarray(res)[None])[0]
 
-    def msm_many(self, points, scalars_list):
+    def msm_many(self, points, scalars_list, base_key=None):
         """Commit several scalar vectors against one cached device base.
 
         With >1 local device the batch axis is sharded over a 1-D mesh
         (SURVEY §2c(b): inter-proof/column DP); single-chip it loops the
-        sequential kernel (measured faster than vmap there)."""
+        sequential kernel (measured faster than vmap there). GLV modes
+        thread the scalar-prep stage through the DP path: half-scalars and
+        sign masks are stacked per batch row against ONE replicated
+        endomorphism-expanded base (`fixed` uses the glv+signed kernels
+        here — replicating a per-window table across the mesh would
+        multiply its memory by the device count)."""
         import jax
         import jax.numpy as jnp
 
@@ -273,13 +313,32 @@ class TpuBackend(CpuBackend):
             mmax = min(points.shape[0],
                        max(s.shape[0] for s in scalars_list))
             pts = self._base_points(points, mmax)
-            sc = np.zeros((batch, mmax, 16), dtype=np.uint32)
+            mode = MSM.msm_mode()
+            if mode == "vanilla":
+                sc = np.zeros((batch, mmax, 16), dtype=np.uint32)
+                for i, s in enumerate(scalars_list):
+                    mi = min(mmax, s.shape[0])
+                    sc[i, :mi] = np.asarray(L16.u64limbs_to_u16limbs(s[:mi]))
+                res = batch_msm_dp(pts, sc)                # [B, 3, 16]
+                return list(ec.decode_points(np.asarray(res)))
+            from ..ops import glv
+            signed = mode in ("glv+signed", "fixed")
+            pts2 = MSM._expand_endo(pts)
+            sc = np.zeros((batch, 2 * mmax, glv.HALF_LIMBS), dtype=np.uint32)
+            ng = np.zeros((batch, 2 * mmax), dtype=bool)
             for i, s in enumerate(scalars_list):
                 mi = min(mmax, s.shape[0])
-                sc[i, :mi] = np.asarray(L16.u64limbs_to_u16limbs(s[:mi]))
-            res = batch_msm_dp(pts, sc)                    # [B, 3, 16]
+                sc64 = np.zeros((mmax, 4), dtype=np.uint64)
+                sc64[:mi] = s[:mi]
+                a1, a2, n1, n2 = glv.decompose_limbs16(
+                    L16.u64limbs_to_u16limbs(sc64))
+                sc[i] = np.concatenate([a1, a2], axis=0)
+                ng[i] = np.concatenate([n1, n2], axis=0)
+            res = batch_msm_dp(pts2, sc, neg_batch=ng,
+                               nbits=glv.glv_bits(), signed=signed)
             return list(ec.decode_points(np.asarray(res)))
-        return [self.msm(points, s) for s in scalars_list]
+        return [self.msm(points, s, base_key=base_key)
+                for s in scalars_list]
 
     # NTTs at least this large ride the four-step mesh-sharded kernel
     # (all-to-all transpose over ICI, parallel/sharded_ntt.py) when >1

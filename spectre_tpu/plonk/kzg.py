@@ -23,10 +23,12 @@ R = bn254.R
 
 
 def commit(srs: SRS, coeffs: np.ndarray, bk=None):
-    """Commit to coefficient-form poly: MSM over tau powers."""
+    """Commit to coefficient-form poly: MSM over tau powers. The SRS digest
+    rides along as the fixed-base table key (SPECTRE_MSM_MODE=fixed reuses
+    one precomputed window table per SRS across every commitment)."""
     bk = bk or B.get_backend()
     assert coeffs.shape[0] <= srs.n, "poly larger than SRS"
-    return bk.msm(srs.g1_powers, coeffs)
+    return bk.msm(srs.g1_powers, coeffs, base_key=srs.digest())
 
 
 def commit_many(srs: SRS, coeffs_list: list, bk=None) -> list:
@@ -35,7 +37,7 @@ def commit_many(srs: SRS, coeffs_list: list, bk=None) -> list:
     bk = bk or B.get_backend()
     for c in coeffs_list:
         assert c.shape[0] <= srs.n, "poly larger than SRS"
-    return bk.msm_many(srs.g1_powers, coeffs_list)
+    return bk.msm_many(srs.g1_powers, coeffs_list, base_key=srs.digest())
 
 
 def commit_lagrange(srs: SRS, domain: Domain, evals: np.ndarray, bk=None):

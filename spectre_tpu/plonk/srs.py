@@ -30,6 +30,20 @@ class SRS:
         self.g1_powers = g1_powers
         self.g2_gen = g2_gen
         self.g2_tau = g2_tau
+        self._digest = None
+
+    def digest(self) -> str:
+        """Stable content digest of the G1 base (hex). Keys the fixed-base
+        MSM table cache (ops.msm) across processes and re-encodings — two
+        SRS objects loaded from the same ceremony share tables. Computed
+        once (blake2b over the full power table: ~0.1 s at k=20)."""
+        if self._digest is None:
+            h = hashlib.blake2b(digest_size=16)
+            h.update(b"SPTSRS02")
+            h.update(self.k.to_bytes(4, "little"))
+            h.update(np.ascontiguousarray(self.g1_powers.astype("<u8")).tobytes())
+            self._digest = h.hexdigest()
+        return self._digest
 
     @classmethod
     def unsafe_setup(cls, k: int, seed: bytes = b"spectre-tpu-test-srs") -> "SRS":

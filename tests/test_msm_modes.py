@@ -1,0 +1,200 @@
+"""GLV decomposition, signed-digit recoding, and MSM mode equivalence.
+
+The contract every mode must honor: identical group element out (the
+commitment byte-equality gate rides on this), only the work shape differs.
+"""
+
+import secrets
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spectre_tpu.fields import bn254 as bn
+from spectre_tpu.ops import ec, glv, limbs as L, msm as MSM
+
+
+def _edge_scalars():
+    lam = glv.lam()
+    return [0, 1, 2, bn.R - 1, bn.R - 2, lam, bn.R - lam, lam - 1,
+            (bn.R - 1) // 2, 1 << 128, (1 << 253) - 1]
+
+
+class TestGLVDecompose:
+    def test_recomposes_mod_r(self):
+        lam = glv.lam()
+        for k in _edge_scalars() + [secrets.randbelow(bn.R)
+                                    for _ in range(64)]:
+            k1, k2 = glv.decompose(k)
+            assert (k1 + k2 * lam) % bn.R == k % bn.R, k
+
+    def test_half_scalars_bounded(self):
+        bound = 1 << glv.glv_bits()
+        assert glv.glv_bits() <= 16 * glv.HALF_LIMBS
+        for k in _edge_scalars() + [secrets.randbelow(bn.R)
+                                    for _ in range(64)]:
+            k1, k2 = glv.decompose(k)
+            assert -bound < k1 < bound and -bound < k2 < bound, k
+
+    def test_batch_matches_scalar_path(self):
+        ks = _edge_scalars() + [secrets.randbelow(bn.R) for _ in range(16)]
+        a1, a2, n1, n2 = glv.decompose_batch(ks)
+        for i, k in enumerate(ks):
+            k1, k2 = glv.decompose(k)
+            assert bool(n1[i]) == (k1 < 0) and bool(n2[i]) == (k2 < 0), k
+            assert sum(int(a1[i, j]) << (16 * j)
+                       for j in range(glv.HALF_LIMBS)) == abs(k1)
+            assert sum(int(a2[i, j]) << (16 * j)
+                       for j in range(glv.HALF_LIMBS)) == abs(k2)
+
+    def test_sign_flip_cases(self):
+        """Full-size scalars hit every half-scalar sign combination (small
+        scalars decompose trivially to k1=k, k2=0 — the generator must span
+        the whole of Fr)."""
+        seen = set()
+        g = bn.FR_GENERATOR
+        for k in range(1, 256):
+            k1, k2 = glv.decompose(pow(g, k, bn.R))
+            seen.add((k1 < 0, k2 < 0))
+            if len(seen) == 4:
+                break
+        assert len(seen) == 4, f"only sign patterns {seen} exercised"
+
+    def test_endo_matches_lambda_mul(self):
+        pts = [bn.g1_curve.mul(bn.G1_GEN, 3 * i + 2) for i in range(4)]
+        pts.append(None)     # phi fixes infinity
+        got = ec.decode_points(jax.jit(ec.endo)(ec.encode_points(pts)))
+        lam = glv.lam()
+        for p, g in zip(pts, got):
+            want = bn.g1_curve.mul(p, lam) if p is not None else None
+            want = None if want is None else (int(want[0]), int(want[1]))
+            assert g == want
+
+
+class TestSignedDigits:
+    @pytest.mark.parametrize("c", [4, 8, 11, 13])
+    def test_roundtrip_and_range(self, c):
+        nbits = glv.glv_bits()
+        nwin = (nbits + c) // c
+        vals = [0, 1, (1 << nbits) - 1, 1 << (c - 1), (1 << c) - 1] + \
+            [secrets.randbelow(1 << nbits) for _ in range(16)]
+        limbs = np.zeros((len(vals), glv.HALF_LIMBS), np.uint32)
+        for i, v in enumerate(vals):
+            for j in range(glv.HALF_LIMBS):
+                limbs[i, j] = (v >> (16 * j)) & 0xFFFF
+        digs = np.asarray(MSM.signed_digit_stream(jnp.asarray(limbs), c, nwin))
+        half = 1 << (c - 1)
+        assert digs.min() >= -half + 1 and digs.max() <= half
+        for i, v in enumerate(vals):
+            back = sum(int(digs[w, i]) << (c * w) for w in range(nwin))
+            assert back == v, (c, v)
+
+    def test_matches_unsigned_stream(self):
+        """The signed stream is a recoding OF the unsigned digit stream:
+        summing both must agree (round-trip through the same scalar)."""
+        import jax
+        c, nbits = 10, glv.glv_bits()
+        nwin_u = (nbits + c - 1) // c
+        nwin_s = (nbits + c) // c
+        k = secrets.randbelow(1 << nbits)
+        limbs = np.zeros((1, glv.HALF_LIMBS), np.uint32)
+        for j in range(glv.HALF_LIMBS):
+            limbs[0, j] = (k >> (16 * j)) & 0xFFFF
+        arr = jnp.asarray(limbs)
+        from spectre_tpu.ops import field_ops as F
+        unsigned = [int(np.asarray(
+            jax.jit(lambda a, w=w: F.limb_digits(a, w, c))(arr))[0])
+            for w in range(nwin_u)]
+        signed = np.asarray(MSM.signed_digit_stream(arr, c, nwin_s))[:, 0]
+        assert sum(d << (c * w) for w, d in enumerate(unsigned)) == \
+            sum(int(d) << (c * w) for w, d in enumerate(signed)) == k
+
+
+class TestMSMModes:
+    def _inputs(self, n=48):
+        pts = [bn.g1_curve.mul(bn.G1_GEN, secrets.randbelow(bn.R))
+               for _ in range(n)]
+        pts[3] = None
+        scalars = [secrets.randbelow(bn.R) for _ in range(n)]
+        scalars[0] = 0
+        scalars[1] = 1
+        scalars[2] = bn.R - 1
+        want = bn.g1_curve.msm(pts, scalars)
+        return (ec.encode_points(pts), jnp.asarray(L.ints_to_limbs16(scalars)),
+                (int(want[0]), int(want[1])))
+
+    @pytest.mark.parametrize("mode", MSM.MSM_MODES)
+    def test_matches_oracle(self, mode):
+        pp, ss, want = self._inputs()
+        got = ec.decode_points(MSM.msm(pp, ss, mode=mode)[None])[0]
+        assert got == want, mode
+
+    @pytest.mark.parametrize("mode", MSM.MSM_MODES)
+    def test_all_zero_is_identity(self, mode):
+        pts = [bn.g1_curve.mul(bn.G1_GEN, k + 1) for k in range(8)]
+        pp = ec.encode_points(pts)
+        ss = jnp.asarray(L.ints_to_limbs16([0] * 8))
+        assert ec.decode_points(MSM.msm(pp, ss, mode=mode)[None])[0] is None
+
+    def test_env_mode_dispatch(self, monkeypatch):
+        monkeypatch.setenv("SPECTRE_MSM_MODE", "glv+signed")
+        assert MSM.msm_mode() == "glv+signed"
+        monkeypatch.setenv("SPECTRE_MSM_MODE", "bogus")
+        with pytest.raises(ValueError):
+            MSM.msm_mode()
+
+    def test_batch_modes_match_single(self):
+        n, m = 24, 3
+        pts = [bn.g1_curve.mul(bn.G1_GEN, k + 1) for k in range(n)]
+        pp = ec.encode_points(pts)
+        scs = [[(i * 131 + k * 7 + 1) % bn.R for k in range(n)]
+               for i in range(m)]
+        batch = jnp.stack([jnp.asarray(L.ints_to_limbs16(sc)) for sc in scs])
+        for mode in ("glv", "glv+signed", "fixed"):
+            got = ec.decode_points(MSM.msm_batch(pp, batch, mode=mode))
+            for sc, g_pt in zip(scs, got):
+                want = bn.g1_curve.msm(pts, sc)
+                assert g_pt == (int(want[0]), int(want[1])), mode
+
+
+class TestFixedTableCache:
+    def test_hit_and_key_separation(self):
+        pts = ec.encode_points(
+            [bn.g1_curve.mul(bn.G1_GEN, k + 1) for k in range(8)])
+        ss = jnp.asarray(L.ints_to_limbs16([k * 3 + 1 for k in range(8)]))
+        MSM.msm(pts, ss, mode="fixed", base_key="t-cache-a")
+        builds0, hits0 = MSM._TABLES.builds, MSM._TABLES.hits
+        MSM.msm(pts, ss, mode="fixed", base_key="t-cache-a")
+        assert MSM._TABLES.hits == hits0 + 1
+        assert MSM._TABLES.builds == builds0
+        # a different base key must NOT hit the same table
+        MSM.msm(pts, ss, mode="fixed", base_key="t-cache-b")
+        assert MSM._TABLES.builds == builds0 + 1
+
+    def test_budget_passthrough_uncached(self, monkeypatch):
+        tiny = MSM._TableLRU(1024)     # 1 KB: every table passes through
+        table = jnp.zeros((4, 8, 3, 16), dtype=jnp.uint32)
+        out = tiny.put(("k",), None, table)
+        assert out is table
+        assert tiny.get(("k",), None) is None   # nothing retained
+
+
+class TestDefaultWindowTuning:
+    def test_pinned_unsigned(self):
+        assert [MSM.default_window(n) for n in
+                (1 << 6, 1 << 7, 1 << 12, 1 << 16, 1 << 18)] == \
+            [4, 7, 10, 10, 13]
+
+    def test_pinned_signed(self):
+        # signed digits halve the bucket array -> each size class affords
+        # one larger window (the tuning-table change this PR pins)
+        assert [MSM.default_window(n, signed=True) for n in
+                (1 << 6, 1 << 7, 1 << 12, 1 << 16, 1 << 17, 1 << 18)] == \
+            [5, 8, 11, 11, 11, 13]
+
+    def test_fixed_follows_signed(self):
+        for n in (1 << 7, 1 << 12, 1 << 17, 1 << 20):
+            assert MSM.default_window_fixed(n) == \
+                MSM.default_window(n, signed=True)

@@ -116,14 +116,19 @@ class TestShardedNTT:
 
 
 class TestShardedMsmRouting:
-    def test_backend_routes_large_msm_through_mesh(self, monkeypatch):
+    @pytest.mark.parametrize("mode", ["vanilla", "glv", "glv+signed", "fixed"])
+    def test_backend_routes_large_msm_through_mesh(self, monkeypatch, mode):
         """TpuBackend.msm: >= 2^min_logn points + >1 device -> sharded_msm
-        (tiny threshold here; the production default is 2^20)."""
+        (tiny threshold here; the production default is 2^20). Every MSM
+        mode must survive the mesh: the GLV scalar-prep stage runs before
+        device_put, signed digits recode per shard, and `fixed` degrades to
+        glv+signed (tables don't shard — documented in backend)."""
         import numpy as np
         from spectre_tpu.plonk import backend as B
         from spectre_tpu.native import host
 
         monkeypatch.setenv("SPECTRE_SHARD_MSM_MIN_LOGN", "5")
+        monkeypatch.setenv("SPECTRE_MSM_MODE", mode)
         bk = B.TpuBackend()
         n = 37          # deliberately not divisible by the data axis (pads)
         pts = [bn.g1_curve.mul(bn.G1_GEN, 3 * k + 2) for k in range(n)]
@@ -136,6 +141,36 @@ class TestShardedMsmRouting:
         got = bk.msm(pts64, sc64)
         want = bn.g1_curve.msm(pts, scs)
         assert got == (int(want[0]), int(want[1]))
+
+
+class TestBatchMsmGLVModes:
+    def test_msm_many_glv_modes_match_oracle(self, monkeypatch):
+        """TpuBackend.msm_many on the >1-device batch DP path with the GLV
+        scalar-prep stage threaded through (half-scalar + sign-mask batch
+        rows against one replicated endomorphism-expanded base)."""
+        import numpy as np
+        from spectre_tpu.plonk import backend as B
+        from spectre_tpu.native import host
+
+        n, batch = 32, 3
+        pts = [bn.g1_curve.mul(bn.G1_GEN, 3 * k + 2) for k in range(n)]
+        pts64 = host.points_to_limbs(pts)
+        scs = [[(b * 131071 + k * 7919 + 5) % bn.R for k in range(n)]
+               for b in range(batch)]
+        sc64s = []
+        for sc in scs:
+            sc64 = np.zeros((n, 4), np.uint64)
+            for i, s in enumerate(sc):
+                for j in range(4):
+                    sc64[i, j] = (s >> (64 * j)) & 0xFFFFFFFFFFFFFFFF
+            sc64s.append(sc64)
+        bk = B.TpuBackend()
+        for mode in ("glv", "glv+signed", "fixed"):
+            monkeypatch.setenv("SPECTRE_MSM_MODE", mode)
+            got = bk.msm_many(pts64, sc64s)
+            for sc, g in zip(scs, got):
+                want = bn.g1_curve.msm(pts, sc)
+                assert g == (int(want[0]), int(want[1])), mode
 
 
 class TestMeshProve:
