@@ -1,14 +1,21 @@
 # Build orchestration (reference parity: `justfile` recipes).
 
-.PHONY: all native test test-slow fixtures bench bench-fast setup-committee setup-step lint lint-fast tpu-evidence
+.PHONY: all native test test-slow test-faults fixtures bench bench-fast setup-committee setup-step lint lint-fast tpu-evidence
 
 all: native
 
 native:
 	$(MAKE) -C spectre_tpu/native
 
-test: native lint bench-fast
+test: native lint test-faults bench-fast
 	python -m pytest tests/ -q
+
+# fault-injection tier (PR 3): deterministic resilience suite — beacon
+# retry/backoff + circuit breaker, device-prove -> CPU fallback
+# byte-equality, job-journal crash replay, MSM table-budget degrade.
+# Seconds-scale on tiny specs; also part of the full pytest ladder above.
+test-faults: native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py -q
 
 test-slow: native
 	RUN_SLOW=1 python -m pytest tests/ -q

@@ -547,6 +547,15 @@ def _build_msm_fixed():
             M.msm_fixed_run.__wrapped__(t, s, g, c, nbits)), (table, sc, neg)
 
 
+def _build_msm_bits():
+    import jax.numpy as jnp
+    from ..ops import msm as M
+    pts = jnp.asarray(_u32((8, 3, 16)))
+    sc = jnp.asarray(_u32((8, 8)))      # GLV half-scalar width
+    return (lambda p, s:
+            M.msm_windows_bits.__wrapped__(p, s, 4, 126)), (pts, sc)
+
+
 def _build_endo():
     import jax.numpy as jnp
     from ..ops import ec as E
@@ -615,6 +624,13 @@ KERNELS = [
                _build_msm_signed, in_bits=[16, 16, 1]),
     KernelSpec("msm.msm_fixed_run", "spectre_tpu/ops/msm.py",
                _build_msm_fixed, in_bits=[16, 16, 1]),
+    # PR 3 (fallback coverage): plain-glv mode enters via msm_windows_bits
+    # at GLV half-scalar width — the one MSM entry point not yet traced
+    # (the fixed->glv+signed table-budget degrade rides the already-
+    # registered msm_windows_signed); register it so every mode a degraded
+    # service can select stays under lint
+    KernelSpec("msm.msm_windows_bits", "spectre_tpu/ops/msm.py",
+               _build_msm_bits),
     KernelSpec("ec.endo", "spectre_tpu/ops/ec.py", _build_endo),
     # MXU int8-limb matmul field multiply (shapes stabilized; the
     # dot_general rule reads its preferred_element_type accumulator)

@@ -44,7 +44,9 @@ optimizations (`SPECTRE_MSM_MODE`, see `msm_mode()`):
               ACROSS windows before one weighted aggregation and the final
               window-combine chain disappears; the reduction itself stays
               per-window-sized (a flattened nwin*2n mega-reduction measured
-              ~2x slower — see msm_fixed_run). Implies glv+signed.
+              ~2x slower — see msm_fixed_run). Implies glv+signed. A table
+              that would exceed the budget BY ITSELF degrades the call to
+              glv+signed instead of thrashing (see _degrade_fixed).
 
 All modes produce the identical group element (the byteeq harness pins
 byte-identical commitments); they differ only in work shape.
@@ -349,6 +351,30 @@ def _build_window_table(points, c: int, nwin: int):
     return tables
 
 
+def _fixed_table_bytes(n: int, c: int, nbits: int) -> int:
+    """Exact byte size of the [nwin, 2n, 3, 16] uint32 GLV window table."""
+    nwin = (nbits + c) // c
+    return nwin * 2 * n * 3 * 16 * 4
+
+
+def _fixed_fits_budget(n: int, c: int, nbits: int) -> bool:
+    return _fixed_table_bytes(n, c, nbits) <= _TABLES.budget
+
+
+def _degrade_fixed(n: int, c: int, nbits: int) -> bool:
+    """Graceful degradation (ISSUE 3): when one fixed-base table would
+    exceed the SPECTRE_MSM_TABLE_MB budget, fall back to glv+signed
+    (identical group element, no precompute residency) instead of
+    thrashing an uncacheable doubling-chain rebuild on every MSM — the
+    mesh-sharded path already degrades the same way. Recorded on the
+    ServiceHealth counter `msm_fixed_degraded`."""
+    if _fixed_fits_budget(n, c, nbits):
+        return False
+    from ..utils.health import HEALTH
+    HEALTH.incr("msm_fixed_degraded")
+    return True
+
+
 def fixed_base_table(points, c: int, nwin: int, base_key=None):
     """[nwin, 2n, 3, 16] GLV fixed-base table, LRU-cached: T[w] holds
     2^{cw} * [P ; phi(P)].
@@ -464,14 +490,16 @@ def msm(points, scalars, c: int | None = None, mode: str | None = None,
     from . import glv
     nbits = glv.glv_bits()
     if mode == "fixed":
-        if c is None:
-            c = default_window_fixed(2 * n)
-        nwin = (nbits + c) // c
-        a1, a2, n1, n2 = glv.decompose_limbs16(np.asarray(scalars))
-        sc2 = jnp.asarray(np.concatenate([a1, a2], axis=0))
-        neg = jnp.asarray(np.concatenate([n1, n2], axis=0))
-        table = fixed_base_table(points, c, nwin, base_key=base_key)
-        return msm_fixed_run(table, sc2, neg, c, nbits)
+        cf = c if c is not None else default_window_fixed(2 * n)
+        if _degrade_fixed(n, cf, nbits):
+            mode = "glv+signed"
+        else:
+            nwin = (nbits + cf) // cf
+            a1, a2, n1, n2 = glv.decompose_limbs16(np.asarray(scalars))
+            sc2 = jnp.asarray(np.concatenate([a1, a2], axis=0))
+            neg = jnp.asarray(np.concatenate([n1, n2], axis=0))
+            table = fixed_base_table(points, cf, nwin, base_key=base_key)
+            return msm_fixed_run(table, sc2, neg, cf, nbits)
 
     pts2, sc2, neg = glv_split(points, scalars)
     if mode == "glv":
@@ -518,16 +546,18 @@ def msm_batch(points, scalars_batch, c: int | None = None,
     nbits = glv.glv_bits()
     outs = []
     if mode == "fixed":
-        if c is None:
-            c = default_window_fixed(2 * n)
-        nwin = (nbits + c) // c
-        table = fixed_base_table(points, c, nwin, base_key=base_key)
-        for sc in scalars_batch:
-            a1, a2, n1, n2 = glv.decompose_limbs16(np.asarray(sc))
-            sc2 = jnp.asarray(np.concatenate([a1, a2], axis=0))
-            neg = jnp.asarray(np.concatenate([n1, n2], axis=0))
-            outs.append(msm_fixed_run(table, sc2, neg, c, nbits))
-        return jnp.stack(outs)
+        cf = c if c is not None else default_window_fixed(2 * n)
+        if _degrade_fixed(n, cf, nbits):
+            mode = "glv+signed"
+        else:
+            nwin = (nbits + cf) // cf
+            table = fixed_base_table(points, cf, nwin, base_key=base_key)
+            for sc in scalars_batch:
+                a1, a2, n1, n2 = glv.decompose_limbs16(np.asarray(sc))
+                sc2 = jnp.asarray(np.concatenate([a1, a2], axis=0))
+                neg = jnp.asarray(np.concatenate([n1, n2], axis=0))
+                outs.append(msm_fixed_run(table, sc2, neg, cf, nbits))
+            return jnp.stack(outs)
 
     pts2 = _expand_endo(points)
     if c is None:

@@ -425,3 +425,65 @@ def get_backend(name: str = "cpu"):
     if name not in _backends:
         _backends[name] = CpuBackend() if name == "cpu" else TpuBackend()
     return _backends[name]
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation: device prove -> CPU retry (PR 3, resilient service)
+# ---------------------------------------------------------------------------
+
+def is_device_oom(exc: BaseException) -> bool:
+    """Device out-of-memory classification: XLA surfaces RESOURCE_EXHAUSTED
+    through XlaRuntimeError (type name matched — jaxlib moves the class
+    between releases); injected faults carry an explicit kind."""
+    from ..utils.faults import InjectedFault
+    if isinstance(exc, InjectedFault):
+        return exc.kind == "oom"
+    msg = str(exc)
+    return type(exc).__name__ == "XlaRuntimeError" and (
+        "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+        or "out of memory" in msg)
+
+
+def is_compile_failure(exc: BaseException) -> bool:
+    """Mosaic/XLA compilation failure classification (compile churn on new
+    shapes is an expected hazard of accelerator-resident proving)."""
+    from ..utils.faults import InjectedFault
+    if isinstance(exc, InjectedFault):
+        return exc.kind == "compile"
+    msg = str(exc)
+    if "Mosaic" in msg and ("failed" in msg or "error" in msg.lower()):
+        return True
+    return type(exc).__name__ == "XlaRuntimeError" and (
+        "Compilation failure" in msg or "INTERNAL: Mosaic" in msg)
+
+
+def prove_with_fallback(prove_fn, bk, health=None):
+    """Run `prove_fn(bk)`; on device OOM or compile failure retry ONCE on
+    the CPU backend instead of failing the request (ISSUE 3 tentpole (5)).
+
+    `prove_fn` must be a closure over everything but the backend and
+    byte-deterministic given the same backend + transcript randomness —
+    the CPU retry produces exactly the proof a clean CPU prove would.
+    Fault-injection site `backend.prove` fires here so the degradation
+    path is deterministically testable without a real device OOM.
+    Non-degradable exceptions (witness rejection, bugs) propagate
+    untouched, as does anything raised while already on the CPU backend.
+    """
+    from ..utils import faults
+    if health is None:
+        from ..utils.health import HEALTH as health
+    try:
+        faults.check("backend.prove")
+        return prove_fn(bk)
+    except Exception as exc:
+        if not (is_device_oom(exc) or is_compile_failure(exc)):
+            raise
+        cpu = get_backend("cpu")
+        if bk is cpu or getattr(bk, "name", None) == "cpu":
+            raise                     # already on the fallback tier
+        kind = "oom" if is_device_oom(exc) else "compile"
+        health.incr(f"prove_cpu_fallbacks_{kind}")
+        import sys
+        print(f"[prover] device prove failed ({kind}: {exc}); retrying "
+              f"once on the CPU backend", file=sys.stderr, flush=True)
+        return prove_fn(cpu)

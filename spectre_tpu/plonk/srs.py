@@ -62,6 +62,8 @@ class SRS:
 
     @classmethod
     def load_or_setup(cls, k: int, directory: str | None = None) -> "SRS":
+        from ..utils import faults
+        faults.check("srs.load")    # injection site (resilience tests)
         directory = directory or PARAMS_DIR
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(directory, f"kzg_bn254_{k}.srs")

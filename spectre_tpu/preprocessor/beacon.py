@@ -1,27 +1,205 @@
-"""Minimal Beacon-chain REST client (stdlib urllib; no external deps).
+"""Resilient Beacon-chain REST client (stdlib urllib; no external deps).
 
 Reference parity: the `beacon-api-client` usage in `preprocessor/src/lib.rs`:
 light-client endpoints for finality updates, committee updates and bootstrap.
 Network egress may be unavailable in dev environments; everything above this
 client consumes plain dicts, so tests inject fixtures instead.
+
+PR 3 (resilient service): upstream beacon nodes hiccup constantly under
+load — a client that gives up on the first transient error starves the
+prover. Every GET therefore runs under:
+
+* **retry with exponential backoff + full jitter** — transient failures
+  (HTTP 5xx/429, connection errors, timeouts) retry up to
+  `SPECTRE_BEACON_RETRIES` times with `delay = U(0, min(max, base*2^i))`
+  (full jitter decorrelates a retrying fleet); non-transient HTTP 4xx
+  raise immediately.
+* **Retry-After honor** — a 429/503 carrying Retry-After waits at least
+  that long (seconds form; HTTP-date form falls back to the backoff).
+* **per-attempt vs total deadline split** — each attempt gets at most
+  `timeout` (per-attempt) but the whole call never exceeds
+  `SPECTRE_BEACON_TOTAL_TIMEOUT`; the last attempt's socket timeout is
+  clipped to the remaining budget.
+* **circuit breaker** — `SPECTRE_BEACON_CB_THRESHOLD` consecutive
+  failures trip the breaker OPEN: calls fail fast (CircuitBreakerOpen)
+  without touching the network for `SPECTRE_BEACON_CB_COOLDOWN` seconds,
+  then HALF-OPEN admits one trial request — success closes the breaker,
+  failure re-opens it for another cooldown.
+
+Retries/trips/half-opens are counted on utils.health (HEALTH) and the
+fault-injection site `beacon.fetch` (utils/faults) fires before each
+attempt, so every path above is deterministically testable in CI.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import random
+import time
+import urllib.error
 import urllib.request
+
+from ..utils import faults
+from ..utils.health import HEALTH
+
+
+class CircuitBreakerOpen(RuntimeError):
+    """Failing fast: the breaker is open (upstream considered down)."""
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v else default
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+def _is_transient(exc: BaseException) -> bool:
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code >= 500 or exc.code == 429
+    return isinstance(exc, (urllib.error.URLError, TimeoutError,
+                            ConnectionError, OSError))
+
+
+def _retry_after_seconds(exc: BaseException) -> float | None:
+    """Seconds-form Retry-After from a 429/503 response, if present."""
+    hdrs = getattr(exc, "headers", None)
+    if hdrs is None:
+        return None
+    ra = hdrs.get("Retry-After")
+    if ra is None:
+        return None
+    try:
+        return max(0.0, float(ra))
+    except ValueError:
+        return None     # HTTP-date form: fall back to computed backoff
 
 
 class BeaconClient:
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 retries: int | None = None,
+                 backoff_base: float | None = None,
+                 backoff_max: float | None = None,
+                 total_timeout: float | None = None,
+                 breaker_threshold: int | None = None,
+                 breaker_cooldown: float | None = None,
+                 health=HEALTH, sleep=time.sleep, rng=random.random):
+        """`timeout` is PER-ATTEMPT; `total_timeout` caps the whole
+        retried call. `sleep`/`rng` are injectable for deterministic
+        tests (rng() in [0,1) scales the full-jitter backoff)."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.retries = retries if retries is not None \
+            else _env_int("SPECTRE_BEACON_RETRIES", 4)
+        self.backoff_base = backoff_base if backoff_base is not None \
+            else _env_float("SPECTRE_BEACON_BACKOFF_BASE", 0.25)
+        self.backoff_max = backoff_max if backoff_max is not None \
+            else _env_float("SPECTRE_BEACON_BACKOFF_MAX", 8.0)
+        self.total_timeout = total_timeout if total_timeout is not None \
+            else _env_float("SPECTRE_BEACON_TOTAL_TIMEOUT", 120.0)
+        self.breaker_threshold = breaker_threshold \
+            if breaker_threshold is not None \
+            else _env_int("SPECTRE_BEACON_CB_THRESHOLD", 5)
+        self.breaker_cooldown = breaker_cooldown \
+            if breaker_cooldown is not None \
+            else _env_float("SPECTRE_BEACON_CB_COOLDOWN", 30.0)
+        self.health = health
+        self._sleep = sleep
+        self._rng = rng
+        # breaker state: consecutive failures + open-until timestamp
+        self._consecutive_failures = 0
+        self._opened_at: float | None = None
+        self._half_open = False
+
+    # -- circuit breaker ---------------------------------------------------
+
+    @property
+    def breaker_state(self) -> str:
+        if self._opened_at is None:
+            return "closed"
+        if time.time() - self._opened_at >= self.breaker_cooldown:
+            return "half-open"
+        return "open"
+
+    def _breaker_admit(self):
+        state = self.breaker_state
+        if state == "open":
+            remain = self.breaker_cooldown - (time.time() - self._opened_at)
+            raise CircuitBreakerOpen(
+                f"beacon circuit breaker open for another {remain:.1f}s "
+                f"after {self._consecutive_failures} consecutive failures")
+        if state == "half-open" and not self._half_open:
+            self._half_open = True
+            self.health.incr("beacon_breaker_half_open")
+
+    def _breaker_record(self, ok: bool):
+        if ok:
+            self._consecutive_failures = 0
+            self._opened_at = None
+            self._half_open = False
+            return
+        self._consecutive_failures += 1
+        half_open_failed = self._half_open
+        self._half_open = False
+        if (half_open_failed
+                or self._consecutive_failures >= self.breaker_threshold):
+            if self._opened_at is None or half_open_failed:
+                self.health.incr("beacon_breaker_trips")
+            self._opened_at = time.time()
+
+    # -- retried GET -------------------------------------------------------
 
     def _get(self, path: str) -> dict:
-        req = urllib.request.Request(self.base_url + path,
-                                     headers={"Accept": "application/json"})
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return json.load(resp)
+        self._breaker_admit()
+        url = self.base_url + path
+        deadline = time.time() + self.total_timeout
+        attempt = 0
+        while True:
+            remain = deadline - time.time()
+            if remain <= 0:
+                self._breaker_record(False)
+                raise TimeoutError(
+                    f"beacon GET {path}: total deadline "
+                    f"({self.total_timeout}s) exceeded after "
+                    f"{attempt} attempts")
+            try:
+                faults.check("beacon.fetch")
+                req = urllib.request.Request(
+                    url, headers={"Accept": "application/json"})
+                with urllib.request.urlopen(
+                        req, timeout=min(self.timeout, remain)) as resp:
+                    data = json.load(resp)
+                self._breaker_record(True)
+                return data
+            except faults.InjectedCrash:
+                raise
+            except Exception as exc:
+                self._breaker_record(False)
+                if not _is_transient(exc):
+                    raise
+                if self.breaker_state == "open":
+                    # tripped mid-call: stop hammering a dead upstream
+                    raise CircuitBreakerOpen(
+                        f"beacon circuit breaker tripped during GET {path} "
+                        f"({self._consecutive_failures} consecutive "
+                        f"failures)") from exc
+                if attempt >= self.retries:
+                    raise
+                delay = min(self.backoff_max,
+                            self.backoff_base * (2 ** attempt)) * self._rng()
+                ra = _retry_after_seconds(exc)
+                if ra is not None:
+                    delay = max(delay, ra)
+                delay = min(delay, max(0.0, deadline - time.time()))
+                self.health.incr("beacon_retries")
+                self._sleep(delay)
+                attempt += 1
+
+    # -- endpoints ---------------------------------------------------------
 
     def finality_update(self) -> dict:
         return self._get("/eth/v1/beacon/light_client/finality_update")["data"]

@@ -4,4 +4,8 @@ Reference parity (SURVEY.md L5): `prover/src/` — clap CLI (`args.rs`,
 `cli.rs`), axum JSON-RPC server with `genEvmProof_*` methods (`rpc.rs`,
 `rpc_api.rs`), boot-time `ProverState` (`prover.rs:43-117`), typed client
 (`rpc_client.rs`), `utils committee-poseidon` (`utils.rs`).
+
+Beyond the reference (PR 3): async job pipeline with a crash-safe journal
+(`jobs.py`), health surface (`health` RPC + GET /healthz), and graceful
+degradation of every external dependency (see README "Prover service").
 """

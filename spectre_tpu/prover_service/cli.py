@@ -53,6 +53,11 @@ def main(argv=None):
     r.add_argument("--compress", action="store_true",
                    help="serve two-stage (aggregated) EVM proofs")
     r.add_argument("--k-agg", type=int, default=17)
+    r.add_argument("--params-dir", help="SRS/pk cache dir; also hosts the "
+                   "crash-safe async job journal (jobs.journal.jsonl)")
+    r.add_argument("--job-timeout", type=float, default=None,
+                   help="default per-job deadline in seconds for async "
+                   "submitProof_* jobs (default: none)")
 
     u = sub.add_parser("utils", help="deployment utilities")
     u.add_argument("util", choices=["committee-poseidon"])
@@ -72,9 +77,13 @@ def main(argv=None):
               flush=True)
         state = ProverState(spec, args.k_step, args.k_committee,
                             args.concurrency, args.backend,
+                            params_dir=args.params_dir,
                             compress=args.compress, k_agg=args.k_agg)
-        print(f"serving on {args.host}:{args.port}", flush=True)
-        serve(state, args.host, args.port)
+        print(f"serving on {args.host}:{args.port} "
+              f"(async jobs journaled under "
+              f"{args.params_dir or 'params_dir unset: in-memory only'})",
+              flush=True)
+        serve(state, args.host, args.port, job_timeout=args.job_timeout)
     elif args.cmd == "utils":
         _utils_cmd(args, spec)
     elif args.cmd == "bench":

@@ -1,0 +1,425 @@
+"""Async proof job queue with a crash-safe journal.
+
+The reference Spectre prover is an always-on coprocessor; proving
+synchronously inside the HTTP handler couples request lifetime to a
+multi-minute compute and loses every in-flight proof on a restart. This
+module gives `prover_service` the host-orchestration layer the
+accelerator-resident pipelines (zkSpeed/SZKP, PAPERS.md) assume:
+
+* **JobQueue** — `submit()` returns a job id immediately; a bounded worker
+  pool (sharing `ProverState.semaphore`, so batch + RPC + async load honor
+  ONE concurrency cap) runs a `runner(method, params)` callback per job
+  with per-job timeout and cancellation. The blocking `genEvmProof_*`
+  RPC methods are `submit()` + `wait()` on top of the same queue.
+* **JobJournal** — append-only JSONL under `params_dir`, fsync'd on every
+  state transition (queued -> running -> done/failed). A restarted service
+  replays the journal: finished jobs keep their results (dedup hits),
+  jobs caught mid-prove are re-queued instead of lost. A torn final line
+  (crash mid-append) is tolerated and ignored.
+* **Dedup by witness digest** — jobs are keyed by a sha256 over the
+  canonical (method, params) JSON, so a client that retries a submit (or a
+  restart replay racing a client resubmit) never double-proves.
+
+Timeouts cannot interrupt a compute-bound Python thread, so expiry is
+enforced at the bookkeeping layer: the job is marked failed the moment its
+deadline passes (observed by pollers and by the worker), and the eventual
+runner result is discarded. Cancellation works the same way for running
+jobs and dequeues queued ones outright.
+
+Fault-injection site: `journal.write` (utils/faults) fires inside the
+append path so CI can prove that a journal-write failure fails the job
+rather than wedging the queue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import queue
+import threading
+import time
+
+from ..utils import faults
+from ..utils.health import HEALTH
+
+JOURNAL_NAME = "jobs.journal.jsonl"
+
+# terminal states never transition again; "queued"/"running" are live
+TERMINAL = ("done", "failed", "cancelled")
+
+
+def witness_digest(method: str, params: dict) -> str:
+    """Canonical digest of a proof request — the dedup key."""
+    blob = json.dumps([method, params], sort_keys=True,
+                      separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+@dataclasses.dataclass
+class Job:
+    id: str
+    method: str
+    params: dict
+    digest: str
+    status: str = "queued"
+    submitted_at: float = 0.0
+    started_at: float | None = None
+    finished_at: float | None = None
+    timeout: float | None = None
+    attempts: int = 0
+    result: dict | None = None
+    error: dict | None = None
+    cancel_requested: bool = False
+
+    def public(self) -> dict:
+        """Status view returned by getProofStatus (no result payload)."""
+        d = {"job_id": self.id, "status": self.status,
+             "method": self.method, "digest": self.digest,
+             "attempts": self.attempts,
+             "submitted_at": self.submitted_at}
+        if self.error is not None:
+            d["error"] = self.error
+        return d
+
+
+class JobJournal:
+    """Append-only JSONL journal, fsync'd per record."""
+
+    def __init__(self, directory: str):
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, JOURNAL_NAME)
+        self._lock = threading.Lock()
+
+    def append(self, record: dict):
+        faults.check("journal.write")
+        line = json.dumps(record, sort_keys=True,
+                          separators=(",", ":")) + "\n"
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line)
+                f.flush()
+                os.fsync(f.fileno())
+
+    def replay(self) -> dict[str, Job]:
+        """Fold the journal into the last-known state per job.
+
+        Torn final lines (a crash mid-append) parse-fail and are skipped;
+        every complete record was fsync'd so ordering is trustworthy."""
+        jobs: dict[str, Job] = {}
+        if not os.path.exists(self.path):
+            return jobs
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue                     # torn tail record
+                ev, jid = rec.get("event"), rec.get("job_id")
+                if not jid:
+                    continue
+                if ev == "submit":
+                    jobs[jid] = Job(
+                        id=jid, method=rec.get("method", ""),
+                        params=rec.get("params") or {},
+                        digest=rec.get("digest", ""),
+                        submitted_at=rec.get("ts", 0.0),
+                        timeout=rec.get("timeout"))
+                    continue
+                job = jobs.get(jid)
+                if job is None:
+                    continue                     # journal truncated earlier
+                if ev == "running":
+                    job.status = "running"
+                    job.started_at = rec.get("ts")
+                    job.attempts = rec.get("attempt", job.attempts + 1)
+                elif ev == "requeued":
+                    job.status = "queued"
+                    job.started_at = None
+                elif ev == "done":
+                    job.status = "done"
+                    job.result = rec.get("result")
+                    job.finished_at = rec.get("ts")
+                elif ev == "failed":
+                    job.status = "failed"
+                    job.error = rec.get("error")
+                    job.finished_at = rec.get("ts")
+                elif ev == "cancelled":
+                    job.status = "cancelled"
+                    job.finished_at = rec.get("ts")
+        return jobs
+
+
+class JobQueue:
+    """Bounded async worker pool over a `runner(method, params)` callback.
+
+    `concurrency` sizes the worker threads. `semaphore` (optional) is an
+    EXTERNAL concurrency governor for runners that do not self-govern; the
+    ProverState runner acquires `state.semaphore` inside prove_* itself
+    (non-reentrant — do not pass the same semaphore at both layers), so
+    async jobs, blocking RPCs and batch proves already draw from one
+    permit pool.
+    """
+
+    def __init__(self, runner, concurrency: int = 1,
+                 journal_dir: str | None = None, semaphore=None,
+                 default_timeout: float | None = None, health=HEALTH):
+        self.runner = runner
+        self.concurrency = max(1, int(concurrency))
+        self.semaphore = semaphore
+        self.default_timeout = default_timeout
+        self.health = health
+        self.journal = JobJournal(journal_dir) if journal_dir else None
+        self._jobs: dict[str, Job] = {}
+        self._by_digest: dict[str, str] = {}
+        self._q: queue.Queue = queue.Queue()
+        self._cv = threading.Condition()
+        self._seq = 0
+        self._stopped = False
+        if self.journal is not None:
+            self._recover()
+        self._workers = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"prover-job-worker-{i}")
+            for i in range(self.concurrency)]
+        for t in self._workers:
+            t.start()
+
+    # -- recovery ----------------------------------------------------------
+
+    def _recover(self):
+        replayed = self.journal.replay()
+        for job in replayed.values():
+            self._jobs[job.id] = job
+            # last submit wins the digest slot; terminal-but-failed jobs
+            # stay resubmittable (dedup only pins live/done jobs)
+            if job.status not in ("failed", "cancelled"):
+                self._by_digest[job.digest] = job.id
+            if job.status == "running":
+                # caught mid-prove by a crash: re-run it
+                job.status = "queued"
+                job.started_at = None
+                self._append({"event": "requeued", "job_id": job.id,
+                              "ts": time.time()})
+                self._q.put(job.id)
+                self.health.incr("jobs_requeued")
+            elif job.status == "queued":
+                self._q.put(job.id)
+        if replayed:
+            self.health.incr("journal_replays")
+
+    # -- journal helper ----------------------------------------------------
+
+    def _append(self, record: dict):
+        if self.journal is not None:
+            self.journal.append(record)
+
+    # -- submission / polling ---------------------------------------------
+
+    def submit(self, method: str, params: dict,
+               timeout: float | None = None) -> str:
+        digest = witness_digest(method, params)
+        with self._cv:
+            existing = self._by_digest.get(digest)
+            if existing is not None:
+                job = self._jobs.get(existing)
+                if job is not None and job.status not in ("failed",
+                                                          "cancelled"):
+                    self.health.incr("jobs_deduped")
+                    return job.id
+            self._seq += 1
+            jid = f"{digest[:16]}-{self._seq:04d}"
+            job = Job(id=jid, method=method, params=params, digest=digest,
+                      submitted_at=time.time(),
+                      timeout=(timeout if timeout is not None
+                               else self.default_timeout))
+            self._jobs[jid] = job
+            self._by_digest[digest] = jid
+        try:
+            self._append({"event": "submit", "job_id": jid, "method": method,
+                          "params": params, "digest": digest,
+                          "timeout": job.timeout, "ts": job.submitted_at})
+        except Exception as exc:
+            # a dead journal must not wedge the queue: fail the job loudly
+            with self._cv:
+                job.status = "failed"
+                job.error = _error_dict(exc)
+                job.finished_at = time.time()
+                self._cv.notify_all()
+            self.health.incr("journal_write_failures")
+            return jid
+        self._q.put(jid)
+        self.health.incr("jobs_submitted")
+        return jid
+
+    def status(self, job_id: str) -> dict | None:
+        with self._cv:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return None
+            self._expire_locked(job)
+            return job.public()
+
+    def result(self, job_id: str) -> Job | None:
+        with self._cv:
+            job = self._jobs.get(job_id)
+            if job is not None:
+                self._expire_locked(job)
+            return job
+
+    def wait(self, job_id: str, timeout: float | None = None) -> Job:
+        deadline = None if timeout is None else time.time() + timeout
+        with self._cv:
+            while True:
+                job = self._jobs[job_id]
+                self._expire_locked(job)
+                if job.status in TERMINAL:
+                    return job
+                remain = None if deadline is None else deadline - time.time()
+                if remain is not None and remain <= 0:
+                    return job
+                self._cv.wait(timeout=min(0.5, remain)
+                              if remain is not None else 0.5)
+
+    def cancel(self, job_id: str) -> bool:
+        with self._cv:
+            job = self._jobs.get(job_id)
+            if job is None or job.status in TERMINAL:
+                return False
+            job.cancel_requested = True
+            if job.status == "queued":
+                self._finish_locked(job, "cancelled")
+                return True
+        # running: the worker's result is discarded at completion
+        return True
+
+    def stats(self) -> dict:
+        with self._cv:
+            counts: dict[str, int] = {}
+            for job in self._jobs.values():
+                counts[job.status] = counts.get(job.status, 0) + 1
+            return {"jobs": counts, "workers": self.concurrency}
+
+    def stop(self):
+        self._stopped = True
+        for _ in self._workers:
+            self._q.put(None)
+
+    # -- worker ------------------------------------------------------------
+
+    def _expire_locked(self, job: Job):
+        if (job.status == "running" and job.timeout is not None
+                and job.started_at is not None
+                and time.time() > job.started_at + job.timeout):
+            self._finish_locked(job, "failed",
+                                error={"kind": "TimeoutError",
+                                       "message": f"job exceeded "
+                                       f"{job.timeout}s timeout"})
+            self.health.incr("jobs_timed_out")
+
+    def _finish_locked(self, job: Job, status: str, result=None, error=None):
+        job.status = status
+        job.result = result
+        job.error = error
+        job.finished_at = time.time()
+        self._cv.notify_all()
+        try:
+            rec = {"event": status, "job_id": job.id, "ts": job.finished_at}
+            if result is not None:
+                rec["result"] = result
+            if error is not None:
+                rec["error"] = error
+            self._append(rec)
+        except Exception:
+            # the in-memory state already transitioned; a journal failure
+            # here only costs replay fidelity, never a wedged client
+            self.health.incr("journal_write_failures")
+
+    def _worker_loop(self):
+        while True:
+            jid = self._q.get()
+            if jid is None or self._stopped:
+                return
+            with self._cv:
+                job = self._jobs.get(jid)
+                if job is None or job.status != "queued":
+                    continue                    # cancelled / replaced
+                job.status = "running"
+                job.started_at = time.time()
+                job.attempts += 1
+                attempt = job.attempts
+            try:
+                self._append({"event": "running", "job_id": jid,
+                              "attempt": attempt, "ts": job.started_at})
+            except Exception as exc:
+                with self._cv:
+                    self._finish_locked(job, "failed",
+                                        error=_error_dict(exc))
+                self.health.incr("journal_write_failures")
+                continue
+            sem = self.semaphore
+            try:
+                if sem is not None:
+                    sem.acquire()
+                try:
+                    result = self.runner(job.method, job.params)
+                finally:
+                    if sem is not None:
+                        sem.release()
+            except faults.InjectedCrash:
+                # simulated hard kill: write NOTHING (that is the point —
+                # journal replay must recover a torn "running" state) and
+                # take this worker down like a dead process would
+                raise
+            except Exception as exc:
+                with self._cv:
+                    if job.status == "running":
+                        self._finish_locked(job, "failed",
+                                            error=_error_dict(exc))
+                self.health.incr("jobs_failed")
+                continue
+            with self._cv:
+                if job.cancel_requested:
+                    self._finish_locked(job, "cancelled")
+                    continue
+                if job.status != "running":
+                    continue                    # expired meanwhile: discard
+                self._finish_locked(job, "done", result=result)
+            self.health.incr("jobs_done")
+
+
+def _error_dict(exc: BaseException) -> dict:
+    return {"kind": type(exc).__name__, "message": str(exc)}
+
+
+def ensure_jobs(state, journal_dir: str | None = None, runner=None,
+                default_timeout: float | None = None) -> JobQueue:
+    """Attach (once) a JobQueue to any prover-state-like object.
+
+    Reuses `state.semaphore`/`state.concurrency` when present so the async
+    queue and the blocking/batch paths share one concurrency cap. `runner`
+    defaults to the RPC proof dispatcher."""
+    jobsq = getattr(state, "jobs", None)
+    if jobsq is not None:
+        return jobsq
+    if runner is None:
+        from .rpc import run_proof_method
+        runner = lambda method, params: run_proof_method(state, method,
+                                                         params)
+    # NOTE: no JobQueue-level semaphore here — the default runner goes
+    # through state.prove_* which acquire state.semaphore THEMSELVES
+    # (threading.Semaphore is not reentrant; acquiring at both layers
+    # deadlocks at concurrency=1). The worker-pool size mirrors the same
+    # cap, so queued jobs drain at exactly the governed parallelism.
+    jobsq = JobQueue(
+        runner,
+        concurrency=getattr(state, "concurrency", 1),
+        journal_dir=journal_dir if journal_dir is not None
+        else getattr(state, "params_dir", None),
+        default_timeout=default_timeout)
+    state.jobs = jobsq
+    return jobsq

@@ -5,6 +5,25 @@ methods `genEvmProof_SyncStepCompressed` and
 `genEvmProof_CommitteeUpdateCompressed`; responses carry proof + instances
 (calldata-shaped); the committee variant additionally surfaces the committee
 poseidon commitment (`rpc.rs:106`).
+
+Beyond the reference (PR 3, resilient service):
+
+* **Async job API** — `submitProof_SyncStepCompressed` /
+  `submitProof_CommitteeUpdateCompressed` return a job id immediately;
+  `getProofStatus` / `getProofResult` poll it; `cancelProof` cancels.
+  The blocking `genEvmProof_*` methods keep their reference semantics but
+  run ON TOP of the same queue (submit + wait), so every proof flows
+  through the crash-safe journal and the dedup-by-witness-digest path
+  (prover_service/jobs.py).
+* **Error taxonomy** — request *parsing* and method *dispatch* are
+  separate failure domains: malformed JSON is `-32700 parse error`,
+  non-dict / missing-`jsonrpc` bodies are `-32600 invalid request`,
+  unknown methods `-32601`, missing params `-32602`, witness rejection
+  `-32000`, and unexpected internal prover errors are `-32603 internal
+  error` with a sanitized (exception-class-only) message — internals
+  never leak to the wire as a bogus "parse error".
+* **Health** — the `health` RPC method and GET `/healthz` surface the
+  ServiceHealth degradation counters (utils/health.py) plus queue stats.
 """
 
 from __future__ import annotations
@@ -15,85 +34,203 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..preprocessor.rotation import rotation_args_from_update
 from ..preprocessor.step import step_args_from_finality_update
+from ..utils.health import HEALTH
 from .calldata import encode_calldata
+from .jobs import ensure_jobs
 from .state import ProverState
 
 RPC_METHOD_STEP = "genEvmProof_SyncStepCompressed"
 RPC_METHOD_COMMITTEE = "genEvmProof_CommitteeUpdateCompressed"
+RPC_METHOD_STEP_SUBMIT = "submitProof_SyncStepCompressed"
+RPC_METHOD_COMMITTEE_SUBMIT = "submitProof_CommitteeUpdateCompressed"
+
+# JSON-RPC 2.0 + implementation-defined codes (-32000..-32099 server errors)
+PARSE_ERROR = -32700
+INVALID_REQUEST = -32600
+METHOD_NOT_FOUND = -32601
+INVALID_PARAMS = -32602
+INTERNAL_ERROR = -32603
+WITNESS_REJECTED = -32000
+JOB_NOT_DONE = -32001
+JOB_NOT_FOUND = -32004
+JOB_FAILED = -32005
 
 
 def _error(code, message, id_=None):
     return {"jsonrpc": "2.0", "error": {"code": code, "message": message}, "id": id_}
 
 
+def run_proof_method(state, method: str, params: dict) -> dict:
+    """Prove one request. This is the job-queue runner: everything here runs
+    in a worker thread, and the returned dict is the JSON-RPC `result`
+    (JSON-serializable, journal-safe)."""
+    if method == RPC_METHOD_STEP:
+        spec = state.spec
+        args = step_args_from_finality_update(
+            params["light_client_finality_update"],
+            params["pubkeys"],
+            bytes.fromhex(params["domain"].removeprefix("0x")),
+            spec)
+        proof, instances = state.prove_step(args)
+        return {
+            "proof": "0x" + proof.hex(),
+            "instances": [hex(v) for v in instances],
+            "calldata": "0x" + encode_calldata(instances, proof).hex(),
+        }
+    if method == RPC_METHOD_COMMITTEE:
+        args = rotation_args_from_update(
+            params["light_client_update"], state.spec)
+        proof, instances = state.prove_committee(args)
+        # compressed layout: 12 accumulator limbs then app instances,
+        # poseidon at [12] (reference: rpc.rs:106 `instances[0][12]`)
+        pos_idx = 12 if getattr(state, "compress", False) else 0
+        return {
+            "proof": "0x" + proof.hex(),
+            "instances": [hex(v) for v in instances],
+            "calldata": "0x" + encode_calldata(instances, proof).hex(),
+            "committee_poseidon": hex(instances[pos_idx]),
+        }
+    raise ValueError(f"unprovable method {method}")
+
+
+# error payloads recorded by the job worker map back onto RPC codes when a
+# blocking genEvmProof_* (or getProofResult) surfaces the failure; typed
+# kinds keep their message, anything unexpected becomes a sanitized
+# -32603 (exception class only — internals never leak to the wire)
+_ERROR_KIND_CODES = {
+    "AssertionError": (WITNESS_REJECTED, "witness rejected"),
+    "KeyError": (INVALID_PARAMS, "missing param"),
+    "TimeoutError": (JOB_FAILED, "job failed"),
+}
+
+
+def _job_error(job, id_):
+    err = job.error or {"kind": "Unknown", "message": "job failed"}
+    kind = err.get("kind")
+    if kind in _ERROR_KIND_CODES:
+        code, label = _ERROR_KIND_CODES[kind]
+        return _error(code, f"{label}: {err.get('message', '')}", id_)
+    HEALTH.incr("rpc_internal_errors")
+    return _error(INTERNAL_ERROR, f"internal error ({kind})", id_)
+
+
 class _Handler(BaseHTTPRequestHandler):
-    state: ProverState = None  # class attr injected by serve()
+    state: ProverState = None  # class attrs injected by serve()
+    jobs = None
 
     def log_message(self, fmt, *args):  # quiet by default
         pass
 
-    def do_POST(self):
-        if self.path not in ("/rpc", "/"):
-            self.send_error(404)
-            return
-        try:
-            length = int(self.headers.get("Content-Length", "0"))
-            req = json.loads(self.rfile.read(length))
-            resp = self._dispatch(req)
-        except Exception as exc:  # malformed request
-            resp = _error(-32700, f"parse error: {exc}")
+    def _reply(self, resp: dict, status: int = 200):
         body = json.dumps(resp).encode()
-        self.send_response(200)
+        self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
+    def do_GET(self):
+        if self.path not in ("/healthz", "/health"):
+            self.send_error(404)
+            return
+        snap = HEALTH.snapshot()
+        snap["status"] = "ok"
+        snap["jobs"] = self.jobs.stats() if self.jobs is not None else {}
+        self._reply(snap)
+
+    def do_POST(self):
+        if self.path not in ("/rpc", "/"):
+            self.send_error(404)
+            return
+        # failure domain 1: transport + JSON parsing -> -32700
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            raw = self.rfile.read(length)
+            req = json.loads(raw)
+        except Exception as exc:
+            self._reply(_error(PARSE_ERROR, f"parse error: {exc}"))
+            return
+        # failure domain 2: JSON-RPC envelope validation -> -32600
+        if not isinstance(req, dict) or req.get("jsonrpc") != "2.0" \
+                or not isinstance(req.get("method"), str):
+            self._reply(_error(INVALID_REQUEST,
+                               "invalid request: expected a JSON-RPC 2.0 "
+                               "object with jsonrpc='2.0' and a method"))
+            return
+        # failure domain 3: dispatch — typed app errors keep their codes,
+        # anything unexpected is a sanitized -32603 internal error
+        id_ = req.get("id")
+        try:
+            resp = self._dispatch(req)
+        except AssertionError as exc:
+            resp = _error(WITNESS_REJECTED, f"witness rejected: {exc}", id_)
+        except KeyError as exc:
+            resp = _error(INVALID_PARAMS, f"missing param: {exc}", id_)
+        except Exception as exc:
+            HEALTH.incr("rpc_internal_errors")
+            resp = _error(INTERNAL_ERROR,
+                          f"internal error ({type(exc).__name__})", id_)
+        self._reply(resp)
+
     def _dispatch(self, req: dict) -> dict:
         id_ = req.get("id")
-        method = req.get("method")
+        method = req["method"]
         params = req.get("params") or {}
-        try:
-            if method == RPC_METHOD_STEP:
-                spec = self.state.spec
-                args = step_args_from_finality_update(
-                    params["light_client_finality_update"],
-                    params["pubkeys"],
-                    bytes.fromhex(params["domain"].removeprefix("0x")),
-                    spec)
-                proof, instances = self.state.prove_step(args)
-                result = {
-                    "proof": "0x" + proof.hex(),
-                    "instances": [hex(v) for v in instances],
-                    "calldata": "0x" + encode_calldata(instances, proof).hex(),
-                }
-            elif method == RPC_METHOD_COMMITTEE:
-                args = rotation_args_from_update(
-                    params["light_client_update"], self.state.spec)
-                proof, instances = self.state.prove_committee(args)
-                # compressed layout: 12 accumulator limbs then app instances,
-                # poseidon at [12] (reference: rpc.rs:106 `instances[0][12]`)
-                pos_idx = 12 if self.state.compress else 0
-                result = {
-                    "proof": "0x" + proof.hex(),
-                    "instances": [hex(v) for v in instances],
-                    "calldata": "0x" + encode_calldata(instances, proof).hex(),
-                    "committee_poseidon": hex(instances[pos_idx]),
-                }
-            elif method == "ping":
-                result = "pong"
-            else:
-                return _error(-32601, f"unknown method {method}", id_)
-        except AssertionError as exc:
-            return _error(-32000, f"witness rejected: {exc}", id_)
-        except KeyError as exc:
-            return _error(-32602, f"missing param: {exc}", id_)
+        if method in (RPC_METHOD_STEP, RPC_METHOD_COMMITTEE):
+            # blocking reference semantics, implemented over the queue:
+            # submit (dedup'd + journaled) then wait for the terminal state
+            jid = self.jobs.submit(method, params)
+            job = self.jobs.wait(jid)
+            if job.status == "done":
+                return {"jsonrpc": "2.0", "result": job.result, "id": id_}
+            if job.status == "cancelled":
+                return _error(JOB_FAILED, "job cancelled", id_)
+            return _job_error(job, id_)
+        if method in (RPC_METHOD_STEP_SUBMIT, RPC_METHOD_COMMITTEE_SUBMIT):
+            blocking = {RPC_METHOD_STEP_SUBMIT: RPC_METHOD_STEP,
+                        RPC_METHOD_COMMITTEE_SUBMIT: RPC_METHOD_COMMITTEE}
+            timeout = params.pop("timeout", None)
+            jid = self.jobs.submit(blocking[method], params, timeout=timeout)
+            st = self.jobs.status(jid)
+            result = {"job_id": jid, "status": st["status"]}
+        elif method == "getProofStatus":
+            st = self.jobs.status(params["job_id"])
+            if st is None:
+                return _error(JOB_NOT_FOUND,
+                              f"unknown job {params['job_id']}", id_)
+            result = st
+        elif method == "getProofResult":
+            job = self.jobs.result(params["job_id"])
+            if job is None:
+                return _error(JOB_NOT_FOUND,
+                              f"unknown job {params['job_id']}", id_)
+            if job.status in ("queued", "running"):
+                return _error(JOB_NOT_DONE,
+                              f"job {job.id} is {job.status}", id_)
+            if job.status != "done":
+                return _job_error(job, id_)
+            result = job.result
+        elif method == "cancelProof":
+            result = {"cancelled": self.jobs.cancel(params["job_id"])}
+        elif method == "health":
+            result = HEALTH.snapshot()
+            result["jobs"] = self.jobs.stats() if self.jobs else {}
+        elif method == "ping":
+            result = "pong"
+        else:
+            return _error(METHOD_NOT_FOUND, f"unknown method {method}", id_)
         return {"jsonrpc": "2.0", "result": result, "id": id_}
 
 
 def serve(state: ProverState, host: str = "127.0.0.1", port: int = 3000,
-          background: bool = False):
+          background: bool = False, journal_dir: str | None = None,
+          job_timeout: float | None = None):
+    """`journal_dir` defaults to the state's params_dir (when set) — pass
+    explicitly to place the crash-safe job journal elsewhere; `job_timeout`
+    is the default per-job deadline for async submissions."""
     _Handler.state = state
+    _Handler.jobs = ensure_jobs(state, journal_dir=journal_dir,
+                                default_timeout=job_timeout)
     server = ThreadingHTTPServer((host, port), _Handler)
     if background:
         t = threading.Thread(target=server.serve_forever, daemon=True)

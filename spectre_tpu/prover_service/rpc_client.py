@@ -1,33 +1,79 @@
-"""Typed JSON-RPC client (reference parity: `prover/src/rpc_client.rs:39-93`)."""
+"""Typed JSON-RPC client (reference parity: `prover/src/rpc_client.rs:39-93`).
+
+PR 3: requests carry a timeout and retry ONCE on a connection reset (the
+service restarting under a rolling deploy is the common case); an `error`
+member in the response raises a typed `RpcError(code, message)` instead of
+a bare KeyError. The async job API (`submitProof_*` / `getProofStatus` /
+`getProofResult`) is exposed alongside the blocking reference methods,
+plus a `wait_for_proof` poll helper and `health`/`healthz` probes.
+"""
 
 from __future__ import annotations
 
+import http.client
 import json
+import time
+import urllib.error
 import urllib.request
 
-from .rpc import RPC_METHOD_COMMITTEE, RPC_METHOD_STEP
+from .rpc import (RPC_METHOD_COMMITTEE, RPC_METHOD_COMMITTEE_SUBMIT,
+                  RPC_METHOD_STEP, RPC_METHOD_STEP_SUBMIT)
+
+
+class RpcError(RuntimeError):
+    """A JSON-RPC error response (code + message, as sent by the server)."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(f"rpc error {code}: {message}")
+        self.code = code
+        self.message = message
+
+
+def _is_conn_reset(exc: BaseException) -> bool:
+    if isinstance(exc, (ConnectionResetError, ConnectionRefusedError,
+                        http.client.RemoteDisconnected)):
+        return True
+    reason = getattr(exc, "reason", None)
+    return isinstance(reason, (ConnectionResetError, ConnectionRefusedError))
 
 
 class ProverClient:
-    def __init__(self, url: str, timeout: float = 3600.0):
+    def __init__(self, url: str, timeout: float = 3600.0,
+                 conn_retries: int = 1):
         self.url = url
         self.timeout = timeout
+        self.conn_retries = conn_retries
         self._id = 0
 
-    def _call(self, method: str, params: dict):
+    def _call(self, method: str, params: dict, timeout: float | None = None):
         self._id += 1
         body = json.dumps({"jsonrpc": "2.0", "method": method,
                            "params": params, "id": self._id}).encode()
-        req = urllib.request.Request(
-            self.url, data=body, headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            data = json.load(resp)
+        attempt = 0
+        while True:
+            req = urllib.request.Request(
+                self.url, data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=timeout or self.timeout) as resp:
+                    data = json.load(resp)
+                break
+            except Exception as exc:
+                if _is_conn_reset(exc) and attempt < self.conn_retries:
+                    attempt += 1
+                    continue
+                raise
         if "error" in data:
-            raise RuntimeError(f"rpc error: {data['error']}")
+            err = data["error"] or {}
+            raise RpcError(err.get("code", -32603),
+                           err.get("message", "unknown error"))
         return data["result"]
 
     def ping(self) -> str:
-        return self._call("ping", {})
+        return self._call("ping", {}, timeout=min(self.timeout, 30.0))
+
+    # -- blocking reference methods ---------------------------------------
 
     def gen_evm_proof_sync_step_compressed(self, finality_update: dict,
                                            pubkeys: list, domain: str):
@@ -39,3 +85,51 @@ class ProverClient:
 
     def gen_evm_proof_committee_update_compressed(self, update: dict):
         return self._call(RPC_METHOD_COMMITTEE, {"light_client_update": update})
+
+    # -- async job API -----------------------------------------------------
+
+    def submit_sync_step(self, finality_update: dict, pubkeys: list,
+                         domain: str, job_timeout: float | None = None) -> str:
+        params = {"light_client_finality_update": finality_update,
+                  "pubkeys": pubkeys, "domain": domain}
+        if job_timeout is not None:
+            params["timeout"] = job_timeout
+        return self._call(RPC_METHOD_STEP_SUBMIT, params,
+                          timeout=min(self.timeout, 60.0))["job_id"]
+
+    def submit_committee_update(self, update: dict,
+                                job_timeout: float | None = None) -> str:
+        params = {"light_client_update": update}
+        if job_timeout is not None:
+            params["timeout"] = job_timeout
+        return self._call(RPC_METHOD_COMMITTEE_SUBMIT, params,
+                          timeout=min(self.timeout, 60.0))["job_id"]
+
+    def proof_status(self, job_id: str) -> dict:
+        return self._call("getProofStatus", {"job_id": job_id},
+                          timeout=min(self.timeout, 30.0))
+
+    def proof_result(self, job_id: str) -> dict:
+        return self._call("getProofResult", {"job_id": job_id},
+                          timeout=min(self.timeout, 30.0))
+
+    def cancel_proof(self, job_id: str) -> bool:
+        return self._call("cancelProof", {"job_id": job_id},
+                          timeout=min(self.timeout, 30.0))["cancelled"]
+
+    def wait_for_proof(self, job_id: str, poll: float = 1.0,
+                       timeout: float | None = None) -> dict:
+        """Poll getProofStatus until terminal, then return the result.
+        Raises RpcError on a failed job and TimeoutError past `timeout`."""
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            st = self.proof_status(job_id)
+            if st["status"] in ("done", "failed", "cancelled"):
+                return self.proof_result(job_id)
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(f"job {job_id} still {st['status']} "
+                                   f"after {timeout}s")
+            time.sleep(poll)
+
+    def health(self) -> dict:
+        return self._call("health", {}, timeout=min(self.timeout, 30.0))

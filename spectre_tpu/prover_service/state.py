@@ -4,6 +4,11 @@ Reference parity: `prover/src/prover.rs:43-117` (`ProverState::new`: SRS map
 by degree, pkeys for step/committee circuits created from default witnesses)
 and the semaphore-based concurrency cap (`prover.rs:40`) — here a
 threading.Semaphore, acquired by the RPC handlers.
+
+PR 3: every prove routes through `backend.prove_with_fallback` — a device
+OOM / Mosaic compile failure retries once on the CPU backend instead of
+failing the request — and `params_dir` additionally hosts the async job
+journal (`jobs.ensure_jobs` attaches the queue lazily at serve time).
 """
 
 from __future__ import annotations
@@ -30,6 +35,8 @@ class ProverState:
         self.backend = B.get_backend(backend)
         self.concurrency = concurrency
         self.semaphore = threading.Semaphore(concurrency)
+        self.params_dir = params_dir      # also hosts the async job journal
+        self.jobs = None                  # attached lazily (jobs.ensure_jobs)
         self.srs = {}
         for k in {k_step, k_committee}:
             self.srs[k] = SRS.load_or_setup(k, params_dir)
@@ -73,17 +80,17 @@ class ProverState:
         return AggregationArgs(inner_vk=pk.vk, srs=self.srs[k],
                                inner_instances=[inst], proof=proof)
 
-    def _compressed(self, circuit, pk, k, agg_cls, agg_pk, args):
+    def _compressed(self, circuit, pk, k, agg_cls, agg_pk, args, bk=None):
         from ..models import AggregationArgs, AggregationCircuit
         from ..plonk.transcript import KeccakTranscript, PoseidonTranscript
-        app_proof = circuit.prove(pk, self.srs[k], args, self.spec,
-                                  self.backend,
+        bk = bk if bk is not None else self.backend
+        app_proof = circuit.prove(pk, self.srs[k], args, self.spec, bk,
                                   transcript=PoseidonTranscript())
         inst = circuit.get_instances(args, self.spec)
         agg_args = AggregationArgs(inner_vk=pk.vk, srs=self.srs[k],
                                    inner_instances=[inst], proof=app_proof)
         outer = agg_cls.prove(agg_pk, self.srs[self.k_agg], agg_args,
-                              self.spec, self.backend,
+                              self.spec, bk,
                               transcript=KeccakTranscript())
         return outer, AggregationCircuit.get_instances(agg_args, self.spec)
 
@@ -103,11 +110,17 @@ class ProverState:
             self._release_idle_ext_caches(self.step_pk,
                                           getattr(self, "step_agg_pk", None))
             if self.compress:
-                return self._compressed(StepCircuit, self.step_pk,
-                                        self.k_step, self.step_agg,
-                                        self.step_agg_pk, args)
-            proof = StepCircuit.prove(self.step_pk, self.srs[self.k_step],
-                                      args, self.spec, self.backend)
+                return B.prove_with_fallback(
+                    lambda bk: self._compressed(StepCircuit, self.step_pk,
+                                                self.k_step, self.step_agg,
+                                                self.step_agg_pk, args,
+                                                bk=bk),
+                    self.backend)
+            proof = B.prove_with_fallback(
+                lambda bk: StepCircuit.prove(self.step_pk,
+                                             self.srs[self.k_step],
+                                             args, self.spec, bk),
+                self.backend)
         return proof, StepCircuit.get_instances(args, self.spec)
 
     def prove_step_batch(self, args_list: list) -> list:
@@ -133,11 +146,17 @@ class ProverState:
             self._release_idle_ext_caches(
                 self.committee_pk, getattr(self, "committee_agg_pk", None))
             if self.compress:
-                return self._compressed(CommitteeUpdateCircuit,
-                                        self.committee_pk, self.k_committee,
-                                        self.committee_agg,
-                                        self.committee_agg_pk, args)
-            proof = CommitteeUpdateCircuit.prove(
-                self.committee_pk, self.srs[self.k_committee], args,
-                self.spec, self.backend)
+                return B.prove_with_fallback(
+                    lambda bk: self._compressed(CommitteeUpdateCircuit,
+                                                self.committee_pk,
+                                                self.k_committee,
+                                                self.committee_agg,
+                                                self.committee_agg_pk, args,
+                                                bk=bk),
+                    self.backend)
+            proof = B.prove_with_fallback(
+                lambda bk: CommitteeUpdateCircuit.prove(
+                    self.committee_pk, self.srs[self.k_committee], args,
+                    self.spec, bk),
+                self.backend)
         return proof, CommitteeUpdateCircuit.get_instances(args, self.spec)

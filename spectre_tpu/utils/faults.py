@@ -1,0 +1,194 @@
+"""Deterministic fault injection (SPECTRE_FAULT_PLAN) for resilience tests.
+
+Grammar::
+
+    SPECTRE_FAULT_PLAN = entry[,entry...]
+    entry              = site ":" kind [":" count]      (count defaults to 1)
+
+e.g. ``SPECTRE_FAULT_PLAN=beacon.fetch:http503:3,backend.prove:oom`` arms
+three injected HTTP 503s at the beacon-fetch boundary and one simulated
+device OOM at the backend-prove boundary. Each armed entry fires ``count``
+times (in plan order per site) and then disarms; un-named sites are
+zero-cost no-ops.
+
+Injection sites threaded through the codebase:
+
+    beacon.fetch    preprocessor/beacon.py  every REST GET attempt
+    srs.load        plonk/srs.py            SRS file read / setup
+    backend.prove   plonk/backend.py        prove_with_fallback entry
+    journal.write   prover_service/jobs.py  each fsync'd journal append
+
+Kinds and the exception they raise:
+
+    raise       InjectedFault                (generic transient error)
+    oom         InjectedFault, oom-classified by backend.is_device_oom
+    compile     InjectedFault, classified by backend.is_compile_failure
+    http503     urllib HTTPError 503 (Retry-After: 0)
+    http429     urllib HTTPError 429 (Retry-After: 0.01)
+    timeout     TimeoutError
+    connreset   ConnectionResetError
+    ioerror     OSError
+    crash       InjectedCrash (BaseException: simulates a hard worker kill —
+                deliberately NOT caught by ``except Exception`` recovery
+                paths, so journal-replay tests exercise a real mid-prove
+                death)
+
+The registry is thread-safe and records every firing in ``fired`` so tests
+assert exact retry counts. Tests arm plans programmatically via ``arm()``/
+``install_plan()``; CI can arm whole scenarios through the environment.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+
+ENV_VAR = "SPECTRE_FAULT_PLAN"
+
+KINDS = ("raise", "oom", "compile", "http503", "http429", "timeout",
+         "connreset", "ioerror", "crash")
+
+
+class InjectedFault(Exception):
+    """A deliberately injected transient failure."""
+
+    def __init__(self, site: str, kind: str):
+        super().__init__(f"injected fault at {site} ({kind})")
+        self.site = site
+        self.kind = kind
+
+
+class InjectedCrash(BaseException):
+    """Simulated hard kill (power loss / SIGKILL mid-prove).
+
+    BaseException on purpose: the worker's ``except Exception`` failure
+    handling must NOT see it — a crashed worker writes nothing, which is
+    exactly the state journal replay has to recover from."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected crash at {site}")
+        self.site = site
+
+
+def _make_exc(site: str, kind: str) -> BaseException:
+    if kind == "crash":
+        return InjectedCrash(site)
+    if kind in ("raise", "oom", "compile"):
+        return InjectedFault(site, kind)
+    if kind in ("http503", "http429"):
+        import email.message
+        import urllib.error
+        hdrs = email.message.Message()
+        hdrs["Retry-After"] = "0" if kind == "http503" else "0.01"
+        code = 503 if kind == "http503" else 429
+        return urllib.error.HTTPError(f"fault://{site}", code,
+                                      f"injected {kind}", hdrs,
+                                      io.BytesIO(b""))
+    if kind == "timeout":
+        return TimeoutError(f"injected timeout at {site}")
+    if kind == "connreset":
+        return ConnectionResetError(f"injected connection reset at {site}")
+    if kind == "ioerror":
+        return OSError(f"injected I/O error at {site}")
+    raise ValueError(f"unknown fault kind {kind!r} (one of {KINDS})")
+
+
+def parse_plan(text: str) -> list[list]:
+    """Parse the SPECTRE_FAULT_PLAN grammar into [site, kind, remaining]
+    entries (order-preserving; multiple entries per site fire in order)."""
+    plan = []
+    for raw in (text or "").split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        if len(parts) == 2:
+            site, kind, count = parts[0], parts[1], 1
+        elif len(parts) == 3:
+            site, kind, count = parts[0], parts[1], int(parts[2])
+        else:
+            raise ValueError(f"bad fault-plan entry {raw!r} "
+                             f"(want site:kind[:count])")
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} in {raw!r} "
+                             f"(one of {KINDS})")
+        if count < 1:
+            raise ValueError(f"bad fault count in {raw!r}")
+        plan.append([site, kind, count])
+    return plan
+
+
+class FaultRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plan: list[list] = []
+        self._env_seen: str | None = None
+        self.fired: list[tuple[str, str]] = []
+
+    def install_plan(self, text: str):
+        """Replace the active plan (also resets the fired log)."""
+        plan = parse_plan(text)
+        with self._lock:
+            self._plan = plan
+            self._env_seen = None          # explicit plan wins over env
+            self.fired = []
+
+    def arm(self, site: str, kind: str, count: int = 1):
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}")
+        with self._lock:
+            self._plan.append([site, kind, count])
+
+    def clear(self):
+        with self._lock:
+            self._plan = []
+            self._env_seen = ""            # suppress env re-reads until changed
+            self.fired = []
+
+    def _sync_env_locked(self):
+        env = os.environ.get(ENV_VAR, "")
+        if env != (self._env_seen or ""):
+            self._env_seen = env
+            self._plan = parse_plan(env)
+            self.fired = []
+
+    def check(self, site: str):
+        """Fire (raise) the next armed fault for `site`, if any.
+
+        Zero-cost for unarmed sites beyond one dict-free list scan; the env
+        plan is re-parsed only when SPECTRE_FAULT_PLAN changes."""
+        with self._lock:
+            if self._env_seen is not None or not self._plan:
+                self._sync_env_locked()
+            for entry in self._plan:
+                if entry[0] == site and entry[2] > 0:
+                    entry[2] -= 1
+                    self.fired.append((site, entry[1]))
+                    exc = _make_exc(site, entry[1])
+                    break
+            else:
+                return
+        raise exc
+
+    def fired_count(self, site: str | None = None) -> int:
+        with self._lock:
+            if site is None:
+                return len(self.fired)
+            return sum(1 for s, _ in self.fired if s == site)
+
+    def armed(self, site: str | None = None) -> int:
+        """Remaining armed firings (for tests asserting exhaustion)."""
+        with self._lock:
+            return sum(e[2] for e in self._plan
+                       if site is None or e[0] == site)
+
+
+# process-global registry: injection sites call faults.check("<site>")
+REGISTRY = FaultRegistry()
+check = REGISTRY.check
+arm = REGISTRY.arm
+clear = REGISTRY.clear
+install_plan = REGISTRY.install_plan
+fired_count = REGISTRY.fired_count
+armed = REGISTRY.armed

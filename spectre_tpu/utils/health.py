@@ -1,0 +1,49 @@
+"""ServiceHealth: thread-safe degradation/retry counters.
+
+Every graceful-degradation path in the service (beacon retry/backoff,
+circuit-breaker transitions, device-prove CPU fallback, fixed-base MSM
+table-budget degrade, job-queue dedup/requeue) increments a named counter
+here instead of logging and forgetting. The prover service surfaces the
+snapshot via the `health` RPC method and GET /healthz; ROADMAP records the
+counters as the hook for future metrics export (Prometheus et al.).
+
+Dependency-free on purpose: ops/ kernels and the preprocessor increment
+counters without pulling in the service layer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class ServiceHealth:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+        self._started = time.time()
+
+    def incr(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            v = self._counters.get(name, 0) + n
+            self._counters[name] = v
+            return v
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"uptime_s": round(time.time() - self._started, 3),
+                    "counters": dict(sorted(self._counters.items()))}
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._started = time.time()
+
+
+# process-global default: the service, the beacon client and the MSM
+# degrade path all meet on this instance unless a caller injects its own
+HEALTH = ServiceHealth()

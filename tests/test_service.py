@@ -189,14 +189,40 @@ class TestSpectreContract:
 class _FakeState:
     """Canned prover for RPC plumbing tests (real proving is minutes)."""
 
-    def __init__(self, spec):
+    def __init__(self, spec, concurrency=1, delay=0.0):
         self.spec = spec
+        self.concurrency = concurrency
+        self.delay = delay
+        self.active = 0
+        self.max_active = 0
+        self._lock = threading.Lock()
+
+    def _track(self):
+        import contextlib
+        import time
+
+        @contextlib.contextmanager
+        def cm():
+            with self._lock:
+                self.active += 1
+                self.max_active = max(self.max_active, self.active)
+            try:
+                if self.delay:
+                    time.sleep(self.delay)
+                yield
+            finally:
+                with self._lock:
+                    self.active -= 1
+        return cm()
 
     def prove_step(self, args):
-        return b"\x01" * 64, StepCircuit.get_instances(args, self.spec)
+        with self._track():
+            return b"\x01" * 64, StepCircuit.get_instances(args, self.spec)
 
     def prove_committee(self, args):
-        return b"\x02" * 64, CommitteeUpdateCircuit.get_instances(args, self.spec)
+        with self._track():
+            return (b"\x02" * 64,
+                    CommitteeUpdateCircuit.get_instances(args, self.spec))
 
 
 class TestBatchProveAPI:
@@ -227,55 +253,242 @@ class TestBatchProveAPI:
         assert len({t for _, t in seen}) >= 2   # ran on >1 worker
 
 
+def _step_request_params(args):
+    from spectre_tpu.fields import bls12_381 as bls
+    pks = [("0x" + bls.g1_compress((bls.Fq(x), bls.Fq(y))).hex())
+           for x, y in args.pubkeys_uncompressed]
+    update = {
+        "attested_header": _hdr_dict(args.attested_header),
+        "finalized_header": _hdr_dict(args.finalized_header),
+        "finality_branch": ["0x" + b.hex() for b in args.finality_branch],
+        "execution_payload_root": "0x" + args.execution_payload_root.hex(),
+        "execution_branch": ["0x" + b.hex()
+                             for b in args.execution_payload_branch],
+        "sync_aggregate": {
+            "sync_committee_bits": args.participation_bits,
+            "sync_committee_signature": "0x" + args.signature_compressed.hex(),
+        },
+    }
+    return {"light_client_finality_update": update, "pubkeys": pks,
+            "domain": "0x" + args.domain.hex()}
+
+
+def _rpc_post(port, payload, raw=None, timeout=600):
+    body = raw if raw is not None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/rpc", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.load(resp)
+
+
 class TestRPC:
     def test_rpc_roundtrip(self):
-        from spectre_tpu.fields import bls12_381 as bls
         from spectre_tpu.prover_service.rpc import serve
         state = _FakeState(TINY)
         server = serve(state, port=0, background=True)
         port = server.server_address[1]
         try:
             args = default_sync_step_args(TINY)
-            pks = [("0x" + bls.g1_compress((bls.Fq(x), bls.Fq(y))).hex())
-                   for x, y in args.pubkeys_uncompressed]
-            update = {
-                "attested_header": _hdr_dict(args.attested_header),
-                "finalized_header": _hdr_dict(args.finalized_header),
-                "finality_branch": ["0x" + b.hex() for b in args.finality_branch],
-                "execution_payload_root": "0x" + args.execution_payload_root.hex(),
-                "execution_branch": ["0x" + b.hex()
-                                     for b in args.execution_payload_branch],
-                "sync_aggregate": {
-                    "sync_committee_bits": args.participation_bits,
-                    "sync_committee_signature": "0x" + args.signature_compressed.hex(),
-                },
-            }
-            body = json.dumps({
+            data = _rpc_post(port, {
                 "jsonrpc": "2.0", "id": 1,
                 "method": "genEvmProof_SyncStepCompressed",
-                "params": {"light_client_finality_update": update,
-                           "pubkeys": pks,
-                           "domain": "0x" + args.domain.hex()},
-            }).encode()
-            req = urllib.request.Request(
-                f"http://127.0.0.1:{port}/rpc", data=body,
-                headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=600) as resp:
-                data = json.load(resp)
+                "params": _step_request_params(args)})
             assert "result" in data, data
             want = StepCircuit.get_instances(args, TINY)
             assert [int(v, 16) for v in data["result"]["instances"]] == want
             # unknown method -> JSON-RPC error
-            bad = json.dumps({"jsonrpc": "2.0", "id": 2, "method": "nope",
-                              "params": {}}).encode()
-            req2 = urllib.request.Request(
-                f"http://127.0.0.1:{port}/rpc", data=bad,
-                headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req2, timeout=60) as resp:
-                data2 = json.load(resp)
+            data2 = _rpc_post(port, {"jsonrpc": "2.0", "id": 2,
+                                     "method": "nope", "params": {}},
+                              timeout=60)
             assert data2["error"]["code"] == -32601
         finally:
             server.shutdown()
+
+    def test_error_taxonomy(self):
+        """Parsing, envelope validation and dispatch are separate failure
+        domains (ISSUE-3 satellite): malformed JSON is -32700, a non-dict
+        or jsonrpc-less body -32600, and an internal prover error -32603
+        with a sanitized message — never a bogus 'parse error'."""
+        from spectre_tpu.prover_service.rpc import serve
+
+        class Boom(_FakeState):
+            def prove_step(self, args):
+                raise RuntimeError("secret internal path /opt/x leaked")
+
+        server = serve(Boom(TINY), port=0, background=True)
+        port = server.server_address[1]
+        try:
+            # malformed JSON -> parse error
+            data = _rpc_post(port, None, raw=b"{nope", timeout=60)
+            assert data["error"]["code"] == -32700
+            # valid JSON, not an object -> invalid request
+            data = _rpc_post(port, [1, 2, 3], timeout=60)
+            assert data["error"]["code"] == -32600
+            # object without jsonrpc member -> invalid request
+            data = _rpc_post(port, {"method": "ping", "id": 1}, timeout=60)
+            assert data["error"]["code"] == -32600
+            # dispatch blow-up -> internal error, sanitized (class name
+            # only, no exception text on the wire)
+            args = default_sync_step_args(TINY)
+            data = _rpc_post(port, {
+                "jsonrpc": "2.0", "id": 4,
+                "method": "genEvmProof_SyncStepCompressed",
+                "params": _step_request_params(args)})
+            assert data["error"]["code"] == -32603
+            assert "secret internal path" not in data["error"]["message"]
+            assert "RuntimeError" in data["error"]["message"]
+            # missing params -> invalid params, not internal error
+            data = _rpc_post(port, {
+                "jsonrpc": "2.0", "id": 5,
+                "method": "genEvmProof_SyncStepCompressed", "params": {}},
+                timeout=60)
+            assert data["error"]["code"] == -32602
+        finally:
+            server.shutdown()
+
+
+class TestAsyncRPC:
+    def test_submit_poll_result_matches_blocking(self):
+        """ISSUE-3 acceptance: submit -> poll -> result equals the blocking
+        genEvmProof_* result for the same witness (and dedups onto the
+        same job)."""
+        from spectre_tpu.prover_service.rpc import serve
+        state = _FakeState(TINY)
+        server = serve(state, port=0, background=True)
+        port = server.server_address[1]
+        try:
+            args = default_sync_step_args(TINY)
+            params = _step_request_params(args)
+            blocking = _rpc_post(port, {
+                "jsonrpc": "2.0", "id": 1,
+                "method": "genEvmProof_SyncStepCompressed",
+                "params": params})["result"]
+            sub = _rpc_post(port, {
+                "jsonrpc": "2.0", "id": 2,
+                "method": "submitProof_SyncStepCompressed",
+                "params": params})["result"]
+            jid = sub["job_id"]
+            # same witness digest -> dedup onto the already-proved job
+            assert sub["status"] == "done"
+            for _ in range(100):
+                st = _rpc_post(port, {"jsonrpc": "2.0", "id": 3,
+                                      "method": "getProofStatus",
+                                      "params": {"job_id": jid}},
+                               timeout=60)["result"]
+                if st["status"] in ("done", "failed"):
+                    break
+                import time
+                time.sleep(0.05)
+            assert st["status"] == "done"
+            result = _rpc_post(port, {"jsonrpc": "2.0", "id": 4,
+                                      "method": "getProofResult",
+                                      "params": {"job_id": jid}},
+                               timeout=60)["result"]
+            assert result == blocking
+            # unknown job id -> typed error
+            err = _rpc_post(port, {"jsonrpc": "2.0", "id": 5,
+                                   "method": "getProofResult",
+                                   "params": {"job_id": "nope"}},
+                            timeout=60)["error"]
+            assert err["code"] == -32004
+        finally:
+            server.shutdown()
+
+    def test_concurrent_submits_respect_cap(self):
+        """N async submissions drain at the configured concurrency: the
+        worker-pool size mirrors ProverState's semaphore cap."""
+        from spectre_tpu.prover_service.jobs import ensure_jobs
+        state = _FakeState(TINY, concurrency=2, delay=0.05)
+        runner_calls = []
+
+        def runner(method, params):
+            runner_calls.append(method)
+            _, inst = state.prove_step(default_sync_step_args(TINY))
+            return {"instances": [hex(v) for v in inst]}
+
+        q = ensure_jobs(state, runner=runner)
+        jids = [q.submit("m", {"w": i}) for i in range(6)]
+        for jid in jids:
+            assert q.wait(jid, timeout=30).status == "done"
+        assert len(runner_calls) == 6
+        assert state.max_active <= 2       # cap honored
+        assert state.max_active == 2       # ...and actually used
+        q.stop()
+
+    def test_healthz_endpoint(self):
+        from spectre_tpu.prover_service.rpc import serve
+        state = _FakeState(TINY)
+        server = serve(state, port=0, background=True)
+        port = server.server_address[1]
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=60) as resp:
+                data = json.load(resp)
+            assert data["status"] == "ok"
+            assert "counters" in data and "jobs" in data
+            # the RPC method view carries the same counters
+            h = _rpc_post(port, {"jsonrpc": "2.0", "id": 1,
+                                 "method": "health", "params": {}},
+                          timeout=60)["result"]
+            assert "counters" in h
+        finally:
+            server.shutdown()
+
+
+class TestProverClient:
+    def test_typed_rpc_error(self):
+        from spectre_tpu.prover_service.rpc import serve
+        from spectre_tpu.prover_service.rpc_client import ProverClient, RpcError
+        server = serve(_FakeState(TINY), port=0, background=True)
+        port = server.server_address[1]
+        try:
+            client = ProverClient(f"http://127.0.0.1:{port}/rpc", timeout=60)
+            assert client.ping() == "pong"
+            with pytest.raises(RpcError) as e:
+                client._call("definitelyNotAMethod", {})
+            assert e.value.code == -32601
+            assert "unknown method" in e.value.message
+        finally:
+            server.shutdown()
+
+    def test_retries_once_on_connection_reset(self, monkeypatch):
+        from spectre_tpu.prover_service import rpc_client as rc
+        calls = []
+
+        class _Resp:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def read(self):
+                return json.dumps({"jsonrpc": "2.0", "result": "pong",
+                                   "id": 1}).encode()
+
+        def flaky(req, timeout=None):
+            calls.append(timeout)
+            if len(calls) == 1:
+                raise ConnectionResetError("injected reset")
+            return _Resp()
+
+        monkeypatch.setattr(rc.urllib.request, "urlopen", flaky)
+        client = rc.ProverClient("http://127.0.0.1:1/rpc", timeout=5)
+        assert client.ping() == "pong"
+        assert len(calls) == 2             # one reset, one retry
+        # a second reset in a row (fresh call) still fails after the
+        # single retry
+        calls.clear()
+
+        def always_reset(req, timeout=None):
+            calls.append(timeout)
+            raise ConnectionResetError("injected reset")
+
+        monkeypatch.setattr(rc.urllib.request, "urlopen", always_reset)
+        with pytest.raises(ConnectionResetError):
+            client.ping()
+        assert len(calls) == 2
 
 
 class TestCLI:
