@@ -1,15 +1,29 @@
 #!/usr/bin/env python
-"""Benchmark entry point: BN254 MSM throughput, TPU vs measured CPU baseline.
+"""Benchmark entry point: BN254 MSM + NTT throughput vs measured baselines.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
-   "backend": ..., "msm_mode": ..., "impl": ..., "fallback": bool}
+Prints ONE JSON line PER METRIC:
+  {"metric": "bn254_msm_2^k throughput", "value": N, "unit": "points/s",
+   "vs_baseline": N, "backend": ..., "msm_mode": ..., "impl": ...,
+   "fallback": bool}
+  {"metric": "bn254_ntt_2^k throughput", "value": N, "unit": "polys/s",
+   "vs_baseline": N, "backend": ..., "ntt_mode": ..., "impl": "batched",
+   "fallback": bool}
 
-The metric is the north star from BASELINE.md: BN254 MSM points/s (the
-dominant prover cost). Baseline = this repo's native C++ single-thread
-Pippenger measured on this machine (the reference Rust prover cannot run here;
-its MSM is the same algorithm on the same hardware class). `backend` and
+MSM metric (north star from BASELINE.md): BN254 MSM points/s (a dominant
+prover cost). Baseline = this repo's native C++ single-thread Pippenger
+measured on this machine (the reference Rust prover cannot run here; its
+MSM is the same algorithm on the same hardware class). `backend` and
 `msm_mode` are first-class JSON keys — the metric name is never mangled.
+
+NTT metric (ISSUE 4): batched coset-LDE throughput in polys/s — B columns
+of 2^k coefficients extended onto the 4x coset (the quotient-pass shape)
+through the batched FUSED kernel (`ops/ntt.py:coset_lde_std`,
+SPECTRE_NTT_MODE). Baseline = the pre-PR shape: a per-column jitted
+scale-then-radix-2-NTT loop over the same columns on the same platform.
+The batched result is checked byte-identical against the per-column loop
+in-run, so a kernel bug fails loudly instead of producing a fast wrong
+number. `ntt_mode` is a first-class JSON key. BENCH_METRIC=msm|ntt runs
+one metric; default runs both.
 
 MSM mode: SPECTRE_MSM_MODE if set, else the full `fixed` stack
 (GLV + signed digits + per-SRS precomputed tables, ops/msm.py). The result
@@ -43,6 +57,12 @@ FLOOR_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 def bench_msm_mode() -> str:
     return os.environ.get("SPECTRE_MSM_MODE", "fixed")
+
+
+def bench_ntt_mode() -> str:
+    # radix2 is the measured-faster CPU default for the batched kernel;
+    # fourstep is the TPU/MXU-shaped mode (see README "NTT modes")
+    return os.environ.get("SPECTRE_NTT_MODE", "radix2")
 
 
 def build_points(n: int) -> np.ndarray:
@@ -182,13 +202,116 @@ def device_phase(out_path: str):
         raise SystemExit(f"device impls failed: {infra_fail}")
 
 
+def ntt_device_phase(out_path: str):
+    """Child process: batched fused coset-LDE vs the per-column pre-PR
+    loop, SAME platform for both — the ratio isolates the pipeline win."""
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    from spectre_tpu.plonk.backend import setup_compile_cache
+    setup_compile_cache()
+    import jax.numpy as jnp
+
+    from spectre_tpu.fields import bn254 as bn
+    from spectre_tpu.ops import field_ops as F, limbs as L, ntt as NTT
+    from spectre_tpu.plonk.domain import COSET_GEN, EXTENSION
+
+    logn = int(os.environ.get("BENCH_LOGN", "16"))
+    batch = int(os.environ.get("BENCH_NTT_BATCH", "16"))
+    mode = bench_ntt_mode()
+    n = 1 << logn
+    n_ext = n * EXTENSION
+    log_ext = logn + 2
+    omega_ext = bn.fr_root_of_unity(log_ext)
+    g = COSET_GEN
+
+    rng = np.random.default_rng(11)
+    coeffs = rng.integers(0, 2**63, size=(batch, n, 4), dtype=np.uint64)
+    coeffs[:, :, 3] &= (1 << 61) - 1          # < R
+    stack = np.zeros((batch, n_ext, 4), dtype=np.uint64)
+    stack[:, :n] = coeffs
+    std16 = L.u64limbs_to_u16limbs(stack.reshape(-1, 4)).reshape(
+        batch, n_ext, 16)
+    stack_d = jnp.asarray(std16)
+
+    fctx = F.fr_ctx()
+    pow_tab = NTT._power_table(log_ext, g)
+    to_mont_jit = jax.jit(lambda v: F.to_mont(fctx, v))
+
+    def one_col_prepr(x_std):
+        # the FAITHFUL pre-PR per-column shape (backend.ntt /
+        # domain.coeff_to_extended): jitted boundary conversion, then a
+        # separate coset-scale pass and an EAGER op-by-op radix-2 NTT —
+        # the unjitted module functions the backend used to call, one
+        # device dispatch per mont_mul/add/sub/gather per stage
+        m16 = to_mont_jit(x_std)
+        scaled = F.mont_mul(fctx, m16, jnp.asarray(pow_tab))
+        return NTT._ntt_stages(scaled, log_ext, omega_ext)
+
+    # jitted-loop reference (not the headline baseline): the same
+    # per-column pipeline as ONE compiled program per column — isolates
+    # how much of the win is batching+fusion vs dispatch amortization
+    one_col_jit = jax.jit(
+        lambda x: NTT._ntt_stages(
+            F.mont_mul(fctx, F.to_mont(fctx, x), jnp.asarray(pow_tab)),
+            log_ext, omega_ext))
+
+    def run_batched():
+        return np.asarray(NTT.coset_lde_std(stack_d, omega_ext, g,
+                                            mode=mode))
+
+    # compile + correctness gate: the batched fused kernel must be
+    # BYTE-IDENTICAL to the per-column jitted loop (exact arithmetic)
+    want = np.stack([np.asarray(one_col_jit(stack_d[i]))
+                     for i in range(batch)])
+    got = run_batched()
+    if not np.array_equal(want, got):
+        with open(out_path, "w") as f:
+            json.dump({"error": f"ntt batched/{mode} result mismatch vs "
+                       f"per-column loop",
+                       "backend": jax.default_backend()}, f)
+        return
+
+    # the eager pre-PR loop is ~60x slower per column on this box — time a
+    # small sample once and scale (it IS the thing being replaced; burning
+    # the full batch x3 would dominate bench wall-clock)
+    base_cols = min(2, batch)
+    sample = np.asarray(one_col_prepr(stack_d[0]))   # warm compile caches
+    assert np.array_equal(sample, want[0]), "pre-PR loop result mismatch"
+    t0 = time.time()
+    for i in range(base_cols):
+        np.asarray(one_col_prepr(stack_d[i]))
+    base_dt = (time.time() - t0) / base_cols * batch
+
+    jl_dt = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        for i in range(batch):
+            np.asarray(one_col_jit(stack_d[i]))
+        jl_dt = min(jl_dt, time.time() - t0)
+
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        run_batched()
+        dt = min(dt, time.time() - t0)
+
+    with open(out_path, "w") as f:
+        json.dump({"polys_per_s": batch / dt,
+                   "baseline_polys_per_s": batch / base_dt,
+                   "jitted_loop_polys_per_s": batch / jl_dt,
+                   "ntt_mode": mode, "impl": "batched",
+                   "backend": jax.default_backend()}, f)
+
+
 def _run_child(force_cpu: bool, expect: str, timeout: float,
-               platform: str | None = None):
+               platform: str | None = None, kind: str = "msm"):
     """Launch the device phase with a hard deadline; returns dict or None."""
     fd, out = tempfile.mkstemp(suffix=".json")
     os.close(fd)
     env = dict(os.environ, BENCH_PHASE="device", BENCH_EXPECT=expect,
-               BENCH_OUT=out)
+               BENCH_OUT=out, BENCH_KIND=kind)
     if force_cpu:
         env["BENCH_FORCE_CPU"] = "1"
     elif platform:
@@ -233,16 +356,30 @@ def _run_child(force_cpu: bool, expect: str, timeout: float,
 
 def main():
     if os.environ.get("BENCH_PHASE") == "device":
-        device_phase(os.environ["BENCH_OUT"])
+        if os.environ.get("BENCH_KIND") == "ntt":
+            ntt_device_phase(os.environ["BENCH_OUT"])
+        else:
+            device_phase(os.environ["BENCH_OUT"])
         return
 
     fast = "--fast" in sys.argv[1:]
     if fast:
-        # CI tier: seconds-scale 2^12 MSM on pinned CPU, regression-gated
-        # against the checked-in floor (bench_floor.json)
+        # CI tier: seconds-scale 2^12 on pinned CPU, regression-gated
+        # against the checked-in floors (bench_floor.json)
         os.environ.setdefault("BENCH_LOGN", "12")
         os.environ.setdefault("SPECTRE_BENCH_PLATFORM", "cpu")
 
+    which = os.environ.get("BENCH_METRIC", "all")
+    ok = True
+    if which in ("all", "msm"):
+        ok = bench_msm(fast) and ok
+    if which in ("all", "ntt"):
+        ok = bench_ntt(fast) and ok
+    if not ok:
+        sys.exit(1)
+
+
+def bench_msm(fast: bool) -> bool:
     from spectre_tpu.native import host
 
     logn = int(os.environ.get("BENCH_LOGN", "16"))
@@ -289,7 +426,7 @@ def main():
                           "backend": None, "msm_mode": bench_msm_mode(),
                           "impl": None, "fallback": fallback,
                           "failed": True}))
-        sys.exit(1 if fast else 0)
+        return not fast
 
     value = result["points_per_s"]
     record = {
@@ -302,24 +439,85 @@ def main():
         "impl": result.get("impl"),
         "fallback": fallback,
     }
+    return _emit(record, fast, f"bn254_msm_2^{logn}_cpu_points_per_s",
+                 "points/s")
 
+
+def bench_ntt(fast: bool) -> bool:
+    """Batched coset-LDE throughput (polys/s): same subprocess + deadline
+    machinery as the MSM metric; the child measures its own per-column
+    baseline on the same platform and byte-checks the batched kernel
+    against it (see ntt_device_phase)."""
+    logn = int(os.environ.get("BENCH_LOGN", "16"))
+    platform = os.environ.get("SPECTRE_BENCH_PLATFORM")
+    dev_timeout = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "240"))
+    cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "1200"))
+    fallback = False
+    result = None
+    if platform == "cpu":
+        result = _run_child(True, "", cpu_timeout, kind="ntt")
+    else:
+        for attempt in range(int(os.environ.get("BENCH_DEVICE_ATTEMPTS",
+                                                "1"))):
+            result = _run_child(False, "", dev_timeout, platform=platform,
+                                kind="ntt")
+            if result:
+                break
+            print(f"# ntt device attempt {attempt + 1} failed/timed out",
+                  file=sys.stderr, flush=True)
+        if not result:
+            fallback = True
+            result = _run_child(True, "", cpu_timeout, kind="ntt")
+    if not result:
+        print(json.dumps({"metric": f"bn254_ntt_2^{logn} throughput",
+                          "value": 0, "unit": "polys/s", "vs_baseline": 0.0,
+                          "backend": None, "ntt_mode": bench_ntt_mode(),
+                          "impl": None, "fallback": fallback,
+                          "failed": True}))
+        return not fast
+
+    value = result["polys_per_s"]
+    baseline = result.get("baseline_polys_per_s") or value
+    record = {
+        "metric": f"bn254_ntt_2^{logn} throughput",
+        "value": round(value, 2),
+        "unit": "polys/s",
+        "vs_baseline": round(value / baseline, 3),
+        "backend": result.get("backend"),
+        "ntt_mode": result.get("ntt_mode", bench_ntt_mode()),
+        "impl": result.get("impl"),
+        "fallback": fallback,
+    }
+    jl = result.get("jitted_loop_polys_per_s")
+    if jl:
+        # decomposition: how much of vs_baseline is batching+fusion vs
+        # plain dispatch amortization (BASELINE.md records both)
+        record["vs_jitted_loop"] = round(value / jl, 3)
+    return _emit(record, fast, f"bn254_ntt_2^{logn}_cpu_polys_per_s",
+                 "polys/s")
+
+
+def _emit(record: dict, fast: bool, floor_key: str, unit: str) -> bool:
+    """Print the metric line; in --fast mode gate >20% regressions against
+    the checked-in floor (bench_floor.json)."""
+    value = record["value"]
     if fast:
         floor = None
         if os.path.exists(FLOOR_PATH):
             with open(FLOOR_PATH) as f:
                 floors = json.load(f)
-            floor = floors.get(f"bn254_msm_2^{logn}_cpu_points_per_s")
+            floor = floors.get(floor_key)
         if floor is not None:
             record["floor"] = floor
             record["regression"] = bool(value < 0.8 * floor)
         print(json.dumps(record))
         if record.get("regression"):
-            print(f"FAIL: {value:.0f} points/s is >20% below the checked-in "
+            print(f"FAIL: {value} {unit} is >20% below the checked-in "
                   f"floor {floor} (bench_floor.json)", file=sys.stderr)
-            sys.exit(1)
-        return
-
+            return False
+        return True
     print(json.dumps(record))
+    return True
 
 
 if __name__ == "__main__":

@@ -498,8 +498,66 @@ def _build_ntt(inverse=False):
         from ..plonk.domain import Domain
         omega = Domain(3).omega
         a = jnp.asarray(_u32((8, 16)))
-        fn = NTT.intt if inverse else NTT.ntt
-        return (lambda x: fn(x, omega)), (a,)
+        # trace the unjitted kernel core (a jitted wrapper would lint as an
+        # opaque pjit call) at the radix2 default
+        if inverse:
+            return (lambda x: NTT._inv_kernel.__wrapped__(
+                x, omega, None, False, "radix2")), (a,)
+        return (lambda x: NTT._fwd_kernel.__wrapped__(
+            x, omega, None, "radix2")), (a,)
+    return build
+
+
+def _build_ntt_many(inverse=False):
+    def build():
+        import jax.numpy as jnp
+        from ..ops import ntt as NTT
+        from ..plonk.domain import Domain
+        omega = Domain(3).omega
+        a = jnp.asarray(_u32((2, 8, 16)))       # [B, n, 16] column stack
+        if inverse:
+            return (lambda x: NTT._inv_kernel.__wrapped__(
+                x, omega, None, False, "radix2")), (a,)
+        return (lambda x: NTT._fwd_kernel.__wrapped__(
+            x, omega, None, "radix2")), (a,)
+    return build
+
+
+def _build_ntt_fourstep():
+    def build():
+        import jax.numpy as jnp
+        from ..ops import ntt as NTT
+        from ..plonk.domain import Domain
+        omega = Domain(4).omega                 # n=16 -> 4x4 Bailey split
+        a = jnp.asarray(_u32((2, 16, 16)))
+        return (lambda x: NTT._fwd_kernel.__wrapped__(
+            x, omega, None, "fourstep")), (a,)
+    return build
+
+
+def _build_coset_lde(mode):
+    def build():
+        import jax.numpy as jnp
+        from ..ops import ntt as NTT
+        from ..plonk.domain import Domain
+        omega = Domain(4).omega
+        a = jnp.asarray(_u32((2, 16, 16)))
+        # the fused coset-LDE entry: std->mont + g^i scale in stage 0
+        return (lambda x: NTT._fwd_kernel.__wrapped__(
+            x, omega, ("std", 7), mode)), (a,)
+    return build
+
+
+def _build_coset_intt_std():
+    def build():
+        import jax.numpy as jnp
+        from ..ops import ntt as NTT
+        from ..plonk.domain import Domain
+        omega = Domain(4).omega
+        a = jnp.asarray(_u32((2, 16, 16)))
+        # fused inverse: iNTT + combined g^{-i}·n^{-1} + mont->std table
+        return (lambda x: NTT._inv_kernel.__wrapped__(
+            x, omega, 7, True, "radix2")), (a,)
     return build
 
 
@@ -611,6 +669,22 @@ KERNELS = [
                _build_field("inv")),
     KernelSpec("ntt.ntt", "spectre_tpu/ops/ntt.py", _build_ntt(False)),
     KernelSpec("ntt.intt", "spectre_tpu/ops/ntt.py", _build_ntt(True)),
+    # batched / moded NTT pipeline entry points (ISSUE 4): the [B, n, 16]
+    # many-polynomial kernels, the four-step (Bailey) mode, and the fused
+    # coset-LDE boundaries must stay inside the same value budgets as the
+    # per-column radix-2 path they replace
+    KernelSpec("ntt.ntt_many", "spectre_tpu/ops/ntt.py",
+               _build_ntt_many(False)),
+    KernelSpec("ntt.intt_many", "spectre_tpu/ops/ntt.py",
+               _build_ntt_many(True)),
+    KernelSpec("ntt.fourstep", "spectre_tpu/ops/ntt.py",
+               _build_ntt_fourstep()),
+    KernelSpec("ntt.coset_lde_std", "spectre_tpu/ops/ntt.py",
+               _build_coset_lde("radix2")),
+    KernelSpec("ntt.coset_lde_fourstep", "spectre_tpu/ops/ntt.py",
+               _build_coset_lde("fourstep")),
+    KernelSpec("ntt.coset_intt_std", "spectre_tpu/ops/ntt.py",
+               _build_coset_intt_std()),
     KernelSpec("msm.msm_windows", "spectre_tpu/ops/msm.py", _build_msm),
     KernelSpec("msm.combine_windows", "spectre_tpu/ops/msm.py",
                _build_msm_combine),

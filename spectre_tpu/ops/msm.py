@@ -276,24 +276,37 @@ def glv_split(points, scalars):
 # ---------------------------------------------------------------------------
 
 class _TableLRU:
-    """Byte-budgeted LRU over fixed-base window tables (OOM guard).
+    """Byte-budgeted LRU over derived device tables (OOM guard).
 
     Mirrors the quotient-phase `_BudgetedExtLRU` (plonk/prover.py): every
     entry is pure DERIVED data — a doubling-chain expansion of a base the
-    caller still holds — so eviction costs recompute time, never
-    correctness. A 2^16-point GLV table at c=13 is ~252 MB; an unbounded
-    cache across several SRS sizes would quietly eat the prover's memory
-    pool. Budget: SPECTRE_MSM_TABLE_MB, default min(8 GB, 25% of MemTotal).
-    Entries hold a strong ref to the base object so id-derived keys can
-    never alias a recycled array."""
+    caller still holds, or an NTT twiddle/coset power table — so eviction
+    costs recompute time, never correctness. A 2^16-point GLV table at c=13
+    is ~252 MB; an unbounded cache across several SRS sizes would quietly
+    eat the prover's memory pool. Entries hold a strong ref to the base
+    object so id-derived keys can never alias a recycled array.
 
-    def __init__(self, budget_bytes: int):
+    Shared machinery: `ops/ntt.py` instantiates a second LRU over its
+    twiddle/coset tables (SPECTRE_NTT_TABLE_MB); entries there are TUPLES
+    of per-stage arrays, so byte accounting sums over sequence entries."""
+
+    def __init__(self, budget_bytes: int, label: str = "msm fixed-base table",
+                 budget_var: str = "SPECTRE_MSM_TABLE_MB"):
         import collections
         self.budget = budget_bytes
+        self.label = label
+        self.budget_var = budget_var
         self._d = collections.OrderedDict()   # key -> (base_ref, table)
         self._bytes = 0
         self.hits = 0
         self.builds = 0
+        self.evictions = 0
+
+    @staticmethod
+    def _entry_bytes(table) -> int:
+        if isinstance(table, (tuple, list)):
+            return sum(t.size * t.dtype.itemsize for t in table)
+        return table.size * table.dtype.itemsize
 
     def get(self, key, base):
         hit = self._d.get(key)
@@ -304,18 +317,19 @@ class _TableLRU:
         return None
 
     def put(self, key, base, table):
-        nbytes = table.size * table.dtype.itemsize
+        nbytes = self._entry_bytes(table)
         self.builds += 1
         if nbytes > self.budget:
             import sys
-            print(f"[msm] fixed-base table ({nbytes >> 20} MB) exceeds "
-                  f"SPECTRE_MSM_TABLE_MB budget ({self.budget >> 20} MB): "
-                  f"uncached — every fixed-mode MSM rebuilds the doubling "
-                  f"chain", file=sys.stderr, flush=True)
+            print(f"[lru] {self.label} ({nbytes >> 20} MB) exceeds "
+                  f"{self.budget_var} budget ({self.budget >> 20} MB): "
+                  f"uncached — every use rebuilds it",
+                  file=sys.stderr, flush=True)
             return table
         while self._bytes + nbytes > self.budget and self._d:
             _k, (_ref, old) = self._d.popitem(last=False)
-            self._bytes -= old.size * old.dtype.itemsize
+            self._bytes -= self._entry_bytes(old)
+            self.evictions += 1
         self._d[key] = (base, table)
         self._bytes += nbytes
         return table

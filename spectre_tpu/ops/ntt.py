@@ -1,18 +1,47 @@
-"""Radix-2 NTT / iNTT over BN254 Fr on limb tensors (device kernel N3).
+"""Batched, moded NTT / iNTT over BN254 Fr on limb tensors (device kernel N3).
 
 Reference parity: halo2's FFT (`halo2_proofs` best_fft, SURVEY.md §2b N3),
-re-designed for XLA: iterative Cooley-Tukey with a host-precomputed bit-reversal
-permutation and per-stage twiddle tables shipped to device once per (k, omega).
-Each stage is one fully-vectorized butterfly over the whole array — no
-data-dependent control flow, shapes static per k.
+rebuilt as a moded, batched pipeline mirroring the MSM-modes design
+(`ops/msm.py`):
 
-Coset NTTs (quotient-poly evaluation) compose this with elementwise scaling by
-a precomputed power table (see `coset_scale`).
+* **Batched many-polynomial transforms** — every entry point is shape-
+  generic over leading batch axes (`[..., n, 16]`), and `ntt_many` /
+  `intt_many` transform a whole `[B, n, 16]` column stack in ONE compiled
+  kernel with shared per-stage twiddles. The prover's commit phase and the
+  device quotient previously dispatched one kernel per column; per-op
+  dispatch overhead (a 16-round CIOS scan per butterfly stage) amortizes
+  over the batch instead.
+* **`SPECTRE_NTT_MODE=radix2|fourstep`** — `radix2` is the iterative
+  Cooley-Tukey kernel (log n fully-vectorized butterfly stages over the
+  whole array); `fourstep` is the single-device Bailey split (row NTTs →
+  twiddle mult → transpose → column NTTs) reusing the exact decomposition
+  and twiddle matrix of `parallel/sharded_ntt.py` — the MXU-shaped layout
+  of "Enabling AI ASICs for ZKP" (PAPERS.md, arXiv:2604.17808): two
+  batches of short NTTs plus one elementwise/transpose step instead of
+  log n sequential full-array gather stages. Both modes produce
+  BYTE-IDENTICAL results (exact canonical field arithmetic; pinned by
+  tests/test_ntt_modes.py), they differ only in work shape.
+* **Fused coset-LDE** — the `mont_mul(coeffs, g^i)` coset pre-scale folds
+  into stage 0 of the NTT (the stage-0 twiddle is 1, so the scale multiply
+  REPLACES a previously wasted multiply-by-one), making
+  `coset_ntt`/`coset_lde_std` one kernel instead of scale-then-NTT. The
+  inverse path gets the same treatment: `coset_intt` multiplies once by a
+  combined `g^{-i}·n^{-1}` table, and the `_std` variants additionally fold
+  the Montgomery boundary conversion into the same table (std→mont+scale on
+  the way in, mont→std+unscale+1/n on the way out) — zero extra elementwise
+  passes for the quotient pipeline.
+* **Budgeted twiddle/coset tables** — stage twiddles, four-step twiddle
+  matrices and coset power tables live in a byte-budgeted LRU
+  (`SPECTRE_NTT_TABLE_MB`, reusing `ops/msm.py:_TableLRU`) keyed on
+  `(kind, k, omega/g)`. A long-running prover service touching many
+  circuit sizes must not grow host memory per size it ever saw; eviction
+  costs recompute time, never correctness.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -20,8 +49,54 @@ import numpy as np
 
 from ..fields import bn254
 from . import field_ops as F
+from .msm import _TableLRU
 
 R = bn254.R
+
+NTT_MODES = ("radix2", "fourstep")
+
+# fourstep needs at least one row stage and one column stage
+_FOURSTEP_MIN_LOGN = 2
+
+
+def ntt_mode() -> str:
+    """Active NTT mode from SPECTRE_NTT_MODE (default: radix2). Read per
+    call — the jitted kernels key on the mode as a static argument, so
+    flipping the env between calls retraces correctly."""
+    mode = os.environ.get("SPECTRE_NTT_MODE", "radix2")
+    if mode not in NTT_MODES:
+        raise ValueError(
+            f"SPECTRE_NTT_MODE={mode!r}: expected one of {NTT_MODES}")
+    return mode
+
+
+def _resolve_mode(mode: str | None, logn: int) -> str:
+    m = mode if mode is not None else ntt_mode()
+    if m not in NTT_MODES:
+        raise ValueError(f"unknown NTT mode {m!r}")
+    if m == "fourstep" and logn < _FOURSTEP_MIN_LOGN:
+        return "radix2"              # nothing to split
+    return m
+
+
+# ---------------------------------------------------------------------------
+# budgeted twiddle / coset tables (host-side LRU, numpy entries)
+# ---------------------------------------------------------------------------
+
+def _table_budget_bytes() -> int:
+    mb = os.environ.get("SPECTRE_NTT_TABLE_MB")
+    if mb is not None:
+        return int(mb) << 20
+    try:
+        with open("/proc/meminfo") as f:
+            total = int(f.readline().split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        return 1 << 30
+    return min(1 << 30, int(total * 0.10))
+
+
+_TABLES = _TableLRU(_table_budget_bytes(), label="ntt twiddle/coset table",
+                    budget_var="SPECTRE_NTT_TABLE_MB")
 
 
 @functools.cache
@@ -34,10 +109,14 @@ def _bitrev(logn: int) -> np.ndarray:
     return rev
 
 
-@functools.cache
 def _stage_twiddles(logn: int, omega: int):
     """Montgomery twiddle tables per stage: stage s has m=2^s butterflies per
-    block, twiddle_j = omega^(n/(2m) * j), j < m."""
+    block, twiddle_j = omega^(n/(2m) * j), j < m. LRU-cached per (k, omega);
+    entries are numpy so they lift to fresh embedded constants per trace."""
+    key = ("stage", logn, omega)
+    hit = _TABLES.get(key, None)
+    if hit is not None:
+        return hit
     ctx = F.fr_ctx()
     n = 1 << logn
     tables = []
@@ -48,60 +127,311 @@ def _stage_twiddles(logn: int, omega: int):
         for j in range(1, m):
             powers[j] = powers[j - 1] * w % R
         tables.append(ctx.encode(powers))
-    return tables
+    return _TABLES.put(key, None, tuple(tables))
 
 
-def ntt(a: jax.Array, omega: int) -> jax.Array:
-    """NTT of [n, 16] Montgomery limb tensor; returns evaluations in natural
-    order. omega must be a primitive n-th root of unity (host int)."""
+def _twiddle_matrix(logr: int, logc: int, omega: int) -> np.ndarray:
+    """Montgomery [Rr, Cc, 16] table of omega^(jr*kc) — the four-step
+    inter-pass twiddles, shared with `parallel/sharded_ntt.py`. The prover
+    reuses one omega per domain, so this is a one-time cost per circuit
+    size; LRU-budgeted so a service touching many sizes stays bounded."""
+    key = ("mat", logr, logc, omega)
+    hit = _TABLES.get(key, None)
+    if hit is not None:
+        return hit
+    from ..native import host
+
+    rr, cc = 1 << logr, 1 << logc
     ctx = F.fr_ctx()
-    n = a.shape[0]
-    logn = n.bit_length() - 1
-    assert 1 << logn == n
-    tables = _stage_twiddles(logn, omega)
-    a = a[jnp.asarray(_bitrev(logn))]
-    for s in range(logn):
-        m = 1 << s
-        tw = tables[s]                       # [m, 16]
-        blk = a.reshape(n // (2 * m), 2, m, F.NLIMBS)
-        u = blk[:, 0]                        # [n/2m, m, 16]
-        v = F.mont_mul(ctx, blk[:, 1], tw[None])
-        a = jnp.stack([F.add(ctx, u, v), F.sub(ctx, u, v)], axis=1).reshape(n, F.NLIMBS)
-    return a
+    rows = np.empty((rr, cc, 16), dtype=np.uint32)
+    for jr in range(rr):
+        w = pow(omega, jr, R)
+        rows[jr] = ctx.encode_np(
+            host.limbs_to_ints(host.fp_powers(host.FR, w, cc)))
+    return _TABLES.put(key, None, rows)
 
 
-def intt(a: jax.Array, omega: int) -> jax.Array:
-    """Inverse NTT: forward with omega^{-1}, then scale by n^{-1}."""
-    ctx = F.fr_ctx()
-    n = a.shape[0]
-    res = ntt(a, pow(omega, -1, R))
-    ninv = ctx.encode([pow(n, -1, R)])[0]
-    return F.mont_mul(ctx, res, ninv[None])
-
-
-@functools.cache
-def _power_table(logn: int, g: int):
-    """[n, 16] Montgomery table of g^i (host-computed once, cached)."""
+def _power_table(logn: int, g: int) -> np.ndarray:
+    """[n, 16] Montgomery table of g^i (host-computed once, LRU-cached)."""
+    key = ("pow", logn, g)
+    hit = _TABLES.get(key, None)
+    if hit is not None:
+        return hit
     ctx = F.fr_ctx()
     n = 1 << logn
     powers = [1] * n
     for i in range(1, n):
         powers[i] = powers[i - 1] * g % R
-    return ctx.encode(powers)
+    return _TABLES.put(key, None, ctx.encode(powers))
+
+
+def _fused_in_table(logn: int, g: int | None) -> np.ndarray:
+    """Stage-0 pre-scale table for the coset-LDE entry fusions.
+
+    g given: encode(g^i · R) — one mont_mul takes a STANDARD-form input to
+    Montgomery form AND applies the coset scale (mont_mul(x_std, enc(g^i·R))
+    = x·g^i·R²·R^{-1} = mont(g^i·x)); g None: encode(R) row, the plain
+    std→mont conversion fused the same way."""
+    key = ("fin", logn, g)
+    hit = _TABLES.get(key, None)
+    if hit is not None:
+        return hit
+    ctx = F.fr_ctx()
+    r = ctx.r_mod_p
+    if g is None:
+        tab = ctx.encode([r])                # [1, 16], broadcasts
+    else:
+        n = 1 << logn
+        vals = [0] * n
+        acc = r
+        for i in range(n):
+            vals[i] = acc
+            acc = acc * g % R
+        tab = ctx.encode(vals)
+    return _TABLES.put(key, None, tab)
+
+
+def _fused_out_table(logn: int, g: int | None, std: bool) -> np.ndarray:
+    """Post-NTT multiply table for the inverse path, folding up to three
+    elementwise passes into one: the 1/n iNTT scale, the inverse coset
+    unscale g^{-i} (when g is given), and — for std=True — the Montgomery →
+    standard conversion (the table is left UN-encoded, so mont_mul(v_mont,
+    t_std) = v·t in standard form directly)."""
+    key = ("fout", logn, g, std)
+    hit = _TABLES.get(key, None)
+    if hit is not None:
+        return hit
+    ctx = F.fr_ctx()
+    n = 1 << logn
+    ninv = pow(n, -1, R)
+    if g is None:
+        vals = [ninv]                        # [1, 16], broadcasts
+    else:
+        ginv = pow(g, -1, R)
+        vals = [0] * n
+        acc = ninv
+        for i in range(n):
+            vals[i] = acc
+            acc = acc * ginv % R
+    if std:
+        from . import limbs as L
+        tab = L.ints_to_limbs16(vals)        # raw values: output is standard
+    else:
+        tab = ctx.encode(vals)
+    return _TABLES.put(key, None, tab)
+
+
+# ---------------------------------------------------------------------------
+# core transforms (shape-generic over leading batch axes)
+# ---------------------------------------------------------------------------
+
+def _ntt_stages(a, logn: int, omega: int, scale=None):
+    """Iterative radix-2 Cooley-Tukey over axis -2 of a [..., n, 16]
+    Montgomery limb tensor; leading axes are batch.
+
+    `scale` ([n, 16] or [1, 16] numpy) folds an elementwise pre-multiply
+    into stage 0: the stage-0 twiddle is 1 (its multiply is skipped — exact
+    for canonical inputs, mont_mul by one_mont is the identity), so the
+    fused path costs the same butterfly work as the plain transform while
+    replacing the separate scale-then-NTT dispatch."""
+    ctx = F.fr_ctx()
+    n = 1 << logn
+    rev = jnp.asarray(_bitrev(logn))
+    a = jnp.take(a, rev, axis=-2)
+    if scale is not None:
+        s = np.asarray(scale)
+        if s.shape[0] == n:                  # permute alongside the data
+            s = s[np.asarray(_bitrev(logn))]
+        a = F.mont_mul(ctx, a, jnp.asarray(s))
+    tables = _stage_twiddles(logn, omega)
+    for s_i in range(logn):
+        m = 1 << s_i
+        blk = a.reshape(a.shape[:-2] + (n // (2 * m), 2, m, F.NLIMBS))
+        u = blk[..., 0, :, :]
+        v = blk[..., 1, :, :]
+        if s_i:                              # stage-0 twiddle is 1: skip
+            v = F.mont_mul(ctx, v, jnp.asarray(tables[s_i]))
+        a = jnp.stack([F.add(ctx, u, v), F.sub(ctx, u, v)],
+                      axis=-3).reshape(a.shape[:-2] + (n, F.NLIMBS))
+    return a
+
+
+def _ntt_fourstep(a, logn: int, omega: int, scale=None):
+    """Single-device four-step (Bailey) NTT of [..., n, 16]: view x as an
+    Rr x Cc matrix (A[jr, jc] = x[jc*Rr + jr]), length-Cc row NTTs, the
+    omega^(jr*kc) twiddle multiply, a transpose, then length-Rr row NTTs —
+    the exact decomposition `parallel/sharded_ntt.py` shards over a mesh,
+    here kept on one device: log n sequential full-array gather stages
+    become two batches of short NTTs plus one MXU-shaped elementwise +
+    transpose step. Output is natural order, byte-identical to radix2."""
+    ctx = F.fr_ctx()
+    logr = logn // 2
+    logc = logn - logr
+    rr, cc = 1 << logr, 1 << logc
+    omega_row = pow(omega, rr, R)            # length-Cc root (step 1)
+    omega_col = pow(omega, cc, R)            # length-Rr root (step 4)
+    tw = _twiddle_matrix(logr, logc, omega)
+
+    lead = a.shape[:-2]
+    # A[jr, jc] = x[jc*rr + jr]
+    A = jnp.moveaxis(a.reshape(lead + (cc, rr, F.NLIMBS)), -2, -3)
+    if scale is not None:
+        s = np.asarray(scale)
+        if s.shape[0] == (1 << logn):
+            s = np.moveaxis(s.reshape(cc, rr, F.NLIMBS), -2, -3)
+        A = F.mont_mul(ctx, A, jnp.asarray(s))
+    y = _ntt_stages(A, logc, omega_row)      # step 1: row NTTs (rr batched)
+    y = F.mont_mul(ctx, y, jnp.asarray(tw))  # step 2: twiddle
+    y = jnp.moveaxis(y, -2, -3)              # step 3: transpose
+    y = _ntt_stages(y, logr, omega_col)      # step 4: column NTTs
+    # y[kc, kr] = X[kr*cc + kc] -> natural order
+    return jnp.moveaxis(y, -2, -3).reshape(a.shape)
+
+
+def _ntt_nd(a, logn: int, omega: int, scale=None, mode: str = "radix2"):
+    if mode == "fourstep":
+        return _ntt_fourstep(a, logn, omega, scale)
+    return _ntt_stages(a, logn, omega, scale)
+
+
+def _logn_of(a) -> int:
+    n = a.shape[-2]
+    logn = n.bit_length() - 1
+    assert 1 << logn == n, "transform length must be a power of two"
+    return logn
+
+
+# jitted entry kernels: (g, kinds, mode) are static so env flips retrace;
+# tables resolve host-side at trace time and embed as constants
+
+
+def _batch_rows(a, body):
+    """Apply `body` ([n, 16] -> [n, 16]) over the leading batch axes.
+
+    On CPU the columns run SEQUENTIALLY inside the one compiled program
+    (lax.map): a 2^14 column's stage working set is ~1 MB and stays
+    cache-hot across its log n stages, where the fully vectorized [B, n]
+    layout streams B x that per stage and falls out of cache (measured:
+    vectorized batch = 0.89x of a jitted per-column loop on the 1-core
+    reference box; map = one dispatch AND per-column locality). Real
+    vector machines keep the vectorized layout — the batch axis is what
+    fills the VPU. Trace-time host decision; both layouts are the same
+    exact arithmetic, so results are byte-identical either way."""
+    if a.ndim == 2:
+        return body(a)
+    if jax.default_backend() == "cpu":
+        flat = a.reshape((-1,) + a.shape[-2:])
+        return jax.lax.map(body, flat).reshape(a.shape)
+    return body(a)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _fwd_kernel(a, omega: int, in_kind, mode: str):
+    """in_kind: None (mont input, no scale), ("mont", g) fused coset
+    pre-scale on a Montgomery input, ("std", g_or_None) standard-form input
+    with the boundary conversion (+ optional coset scale) fused in."""
+    logn = _logn_of(a)
+    if in_kind is None:
+        scale = None
+    elif in_kind[0] == "mont":
+        scale = _power_table(logn, in_kind[1])
+    else:
+        scale = _fused_in_table(logn, in_kind[1])
+    return _batch_rows(a, lambda row: _ntt_nd(row, logn, omega, scale, mode))
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def _inv_kernel(a, omega: int, g, std: bool, mode: str):
+    """Inverse transform of [..., n, 16]: forward with omega^{-1}, then ONE
+    fused multiply by the combined (1/n, g^{-i}, mont→std) table."""
+    logn = _logn_of(a)
+    omega_inv = pow(omega, -1, R)
+    tab = _fused_out_table(logn, g, std)
+
+    def body(row):
+        res = _ntt_nd(row, logn, omega_inv, None, mode)
+        return F.mont_mul(F.fr_ctx(), res, jnp.asarray(tab))
+
+    return _batch_rows(a, body)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def ntt(a: jax.Array, omega: int, mode: str | None = None) -> jax.Array:
+    """NTT of a [n, 16] Montgomery limb tensor; returns evaluations in
+    natural order. omega must be a primitive n-th root of unity (host int).
+    mode defaults to SPECTRE_NTT_MODE (see `ntt_mode`)."""
+    return _fwd_kernel(a, omega, None, _resolve_mode(mode, _logn_of(a)))
+
+
+def ntt_many(a: jax.Array, omega: int, mode: str | None = None) -> jax.Array:
+    """Batched NTT of a [B, n, 16] stack in one compiled kernel: every
+    butterfly stage processes all B polynomials with shared twiddles."""
+    return _fwd_kernel(a, omega, None, _resolve_mode(mode, _logn_of(a)))
+
+
+def intt(a: jax.Array, omega: int, mode: str | None = None) -> jax.Array:
+    """Inverse NTT: forward with omega^{-1}, then scale by n^{-1}."""
+    return _inv_kernel(a, omega, None, False,
+                       _resolve_mode(mode, _logn_of(a)))
+
+
+def intt_many(a: jax.Array, omega: int, mode: str | None = None) -> jax.Array:
+    """Batched inverse NTT of a [B, n, 16] stack (see `ntt_many`)."""
+    return _inv_kernel(a, omega, None, False,
+                       _resolve_mode(mode, _logn_of(a)))
+
+
+def coset_ntt(a: jax.Array, omega: int, g: int,
+              mode: str | None = None) -> jax.Array:
+    """Fused coset-LDE: evaluations of a on g*<omega> in ONE kernel — the
+    g^i pre-scale rides stage 0 of the NTT instead of a separate pass."""
+    return _fwd_kernel(a, omega, ("mont", g),
+                       _resolve_mode(mode, _logn_of(a)))
+
+
+def coset_intt(a: jax.Array, omega: int, g: int,
+               mode: str | None = None) -> jax.Array:
+    """Fused inverse coset-LDE: one combined g^{-i}·n^{-1} multiply after
+    the inverse transform (two elementwise passes become one)."""
+    return _inv_kernel(a, omega, g, False, _resolve_mode(mode, _logn_of(a)))
+
+
+def coset_ntt_many(a: jax.Array, omega: int, g: int,
+                   mode: str | None = None) -> jax.Array:
+    """Batched fused coset-LDE over a [B, n, 16] stack."""
+    return _fwd_kernel(a, omega, ("mont", g),
+                       _resolve_mode(mode, _logn_of(a)))
+
+
+def coset_intt_many(a: jax.Array, omega: int, g: int,
+                    mode: str | None = None) -> jax.Array:
+    return _inv_kernel(a, omega, g, False, _resolve_mode(mode, _logn_of(a)))
+
+
+def coset_lde_std(a_std: jax.Array, omega: int, g: int | None,
+                  mode: str | None = None) -> jax.Array:
+    """Coset-LDE of STANDARD-form limb input ([..., n, 16]): the std→mont
+    boundary conversion and the coset scale fold into one stage-0 table, so
+    the whole quotient-phase `to_ext` is a single kernel. Returns Montgomery
+    evaluations (the quotient keeps working in Montgomery form)."""
+    return _fwd_kernel(a_std, omega, ("std", g),
+                       _resolve_mode(mode, _logn_of(a_std)))
+
+
+def coset_intt_std(a: jax.Array, omega: int, g: int | None,
+                   mode: str | None = None) -> jax.Array:
+    """Inverse coset-LDE emitting STANDARD-form limbs: 1/n, g^{-i} and the
+    mont→std conversion are ONE multiply by a raw (un-encoded) table."""
+    return _inv_kernel(a, omega, g, True, _resolve_mode(mode, _logn_of(a)))
 
 
 def coset_scale(a: jax.Array, g: int, inverse: bool = False) -> jax.Array:
-    """a_i *= g^i (or g^{-i}) — composes with ntt/intt for coset evaluation."""
+    """a_i *= g^i (or g^{-i}) — the unfused building block, kept for
+    composition outside the NTT (and for oracle tests of the fusion)."""
     ctx = F.fr_ctx()
-    n = a.shape[0]
-    logn = n.bit_length() - 1
+    logn = _logn_of(a)
     tab = _power_table(logn, pow(g, -1, R) if inverse else g)
-    return F.mont_mul(ctx, a, tab)
-
-
-def coset_ntt(a: jax.Array, omega: int, g: int) -> jax.Array:
-    return ntt(coset_scale(a, g), omega)
-
-
-def coset_intt(a: jax.Array, omega: int, g: int) -> jax.Array:
-    return coset_scale(intt(a, omega), g, inverse=True)
+    return F.mont_mul(ctx, a, jnp.asarray(tab))

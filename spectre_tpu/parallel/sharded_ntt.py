@@ -31,22 +31,11 @@ from ..ops import field_ops as F, ntt as NTT
 R = bn254.R
 
 
-@functools.cache
-def _twiddle_matrix(logr: int, logc: int, omega: int) -> np.ndarray:
-    """Montgomery [Rr, Cc, 16] table of omega^(jr*kc). Host-computed and
-    cached per (shape, omega) — the prover reuses one omega per domain, so
-    this is a one-time cost per circuit size (device-side generation is the
-    scale-up path once SRS-sized tables stop fitting host memory)."""
-    from ..native import host
-
-    rr, cc = 1 << logr, 1 << logc
-    ctx = F.fr_ctx()
-    rows = np.empty((rr, cc, 16), dtype=np.uint32)
-    for jr in range(rr):
-        w = pow(omega, jr, R)
-        rows[jr] = ctx.encode_np(
-            host.limbs_to_ints(host.fp_powers(host.FR, w, cc)))
-    return rows
+# Montgomery [Rr, Cc, 16] table of omega^(jr*kc). Shared with the
+# single-device four-step kernel and LRU-budgeted there
+# (SPECTRE_NTT_TABLE_MB): the prover reuses one omega per domain, but a
+# long-running service touching many circuit sizes must stay bounded.
+_twiddle_matrix = NTT._twiddle_matrix
 
 
 def sharded_ntt(a: jax.Array, omega: int, mesh: Mesh,

@@ -130,6 +130,32 @@ class CpuBackend:
         host.fr_ntt(data, pow(omega, -1, R))
         return host.fp_scale_batch(host.FR, data, pow(n, -1, R))
 
+    # -- batched many-polynomial NTT (ISSUE 4 tentpole): ONE backend call
+    #    per column stack. The native kernel is per-polynomial, so the CPU
+    #    tier loops; the device backend overrides with a single compiled
+    #    [B, n, 16] kernel. All lists hold same-length [n, 4] u64 arrays.
+    def ntt_many(self, coeffs_list, omega: int) -> list:
+        return [self.ntt(c, omega) for c in coeffs_list]
+
+    def intt_many(self, evals_list, omega: int) -> list:
+        return [self.intt(e, omega) for e in evals_list]
+
+    def coset_lde_many(self, coeffs_list, omega: int, g: int, n_out: int,
+                       powers=None) -> list:
+        """Coset low-degree extension of several coefficient-form polys to
+        the size-n_out coset g*<omega>: pad, scale by g^i, NTT. `powers`
+        is an optional pre-computed [n_out, 4] table of g^i (the domain
+        caches one per generator); the device backend ignores it and fuses
+        the scale into stage 0 of its batched kernel."""
+        if powers is None:
+            powers = self.powers(g, n_out)
+        out = []
+        for cf in coeffs_list:
+            padded = np.zeros((n_out, 4), dtype=np.uint64)
+            padded[:cf.shape[0]] = cf
+            out.append(self.ntt(self.mul(padded, powers), omega))
+        return out
+
     # -- MSM: points [m, 8] u64 affine standard, scalars [m, 4] --
     def msm(self, points, scalars, base_key=None):
         # base_key names a fixed base for the device table cache; the
@@ -393,6 +419,82 @@ class TpuBackend(CpuBackend):
         if mont_out:
             return res
         return _mont16_to_u64_std(np.asarray(res))
+
+    # batch sizes are padded up to a power of two (zero columns transform
+    # to zero columns and are sliced off) so the jitted [B, n, 16] kernels
+    # compile for at most log2(chunk) distinct batch shapes per n instead
+    # of one executable per ragged chunk length — XLA:CPU compile churn is
+    # this box's known instability (see TestMsmModeCommitments note)
+    @staticmethod
+    def _pad_batch(stack: np.ndarray) -> np.ndarray:
+        b = stack.shape[0]
+        bp = 1 << max(b - 1, 0).bit_length()
+        if bp == b:
+            return stack
+        pad = np.zeros((bp,) + stack.shape[1:], dtype=stack.dtype)
+        pad[:b] = stack
+        return pad
+
+    def _ntt_many_device(self, arrs, omega: int, inverse: bool) -> list:
+        """[B, n, 16] batched kernel path (single device, any NTT mode)."""
+        import jax.numpy as jnp
+
+        from ..ops import ntt as NTT
+
+        b, n = len(arrs), arrs[0].shape[0]
+        stack = self._pad_batch(np.stack(arrs))
+        mont = _u64_std_to_mont16(stack.reshape(-1, 4)).reshape(
+            stack.shape[0], n, 16)
+        fn = NTT.intt_many if inverse else NTT.ntt_many
+        out = fn(jnp.asarray(mont), omega)
+        std = _mont16_to_u64_std(np.asarray(out).reshape(-1, 16))
+        return list(std.reshape(stack.shape[0], n, 4)[:b])
+
+    def ntt_many(self, coeffs_list, omega: int) -> list:
+        if not coeffs_list:
+            return []
+        n = coeffs_list[0].shape[0]
+        if len(coeffs_list) == 1 or self._use_mesh(
+                n, self._shard_ntt_min_logn):
+            return [self.ntt(c, omega) for c in coeffs_list]
+        return self._ntt_many_device(coeffs_list, omega, inverse=False)
+
+    def intt_many(self, evals_list, omega: int) -> list:
+        if not evals_list:
+            return []
+        n = evals_list[0].shape[0]
+        if len(evals_list) == 1 or self._use_mesh(
+                n, self._shard_ntt_min_logn):
+            return [self.intt(e, omega) for e in evals_list]
+        return self._ntt_many_device(evals_list, omega, inverse=True)
+
+    def coset_lde_many(self, coeffs_list, omega: int, g: int, n_out: int,
+                       powers=None) -> list:
+        """Batched FUSED coset-LDE: pad to n_out, then one compiled kernel
+        per stack — the std→mont conversion and the g^i coset scale both
+        fold into stage 0 of the batched NTT (ops/ntt.py:coset_lde_std),
+        so the whole extension is a single device program with no separate
+        scale pass and no intermediate Montgomery array."""
+        import jax.numpy as jnp
+
+        from ..ops import limbs as L16, ntt as NTT
+
+        if not coeffs_list:
+            return []
+        if self._use_mesh(n_out, self._shard_ntt_min_logn):
+            # mesh path: per-poly sharded NTT (scale via the host table)
+            return super().coset_lde_many(coeffs_list, omega, g, n_out,
+                                          powers=powers)
+        b = len(coeffs_list)
+        stack = np.zeros((b, n_out, 4), dtype=np.uint64)
+        for i, cf in enumerate(coeffs_list):
+            stack[i, :cf.shape[0]] = cf
+        stack = self._pad_batch(stack)
+        std16 = L16.u64limbs_to_u16limbs(stack.reshape(-1, 4)).reshape(
+            stack.shape[0], n_out, 16)
+        out = NTT.coset_lde_std(jnp.asarray(std16), omega, g)
+        std = _mont16_to_u64_std(np.asarray(out).reshape(-1, 16))
+        return list(std.reshape(stack.shape[0], n_out, 4)[:b])
 
 
 def _u64_std_to_mont16(arr):

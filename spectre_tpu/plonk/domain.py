@@ -56,6 +56,25 @@ class Domain:
         bk = bk or B.get_backend()
         return bk.ntt(coeffs, self.omega)
 
+    # -- batched many-polynomial transforms (ISSUE 4): one backend call
+    #    per column stack instead of a Python loop of per-column NTTs --
+    def lagrange_to_coeff_many(self, evals_list, bk=None) -> list:
+        bk = bk or B.get_backend()
+        return bk.intt_many(evals_list, self.omega)
+
+    def coeff_to_lagrange_many(self, coeffs_list, bk=None) -> list:
+        bk = bk or B.get_backend()
+        return bk.ntt_many(coeffs_list, self.omega)
+
+    def coset_lde_many(self, coeffs_list, bk=None) -> list:
+        """Batched coset-LDE of degree <n polys onto g*<omega_ext> (size
+        4n) — the many-column form of `coeff_to_extended`, fused
+        scale+NTT on the device backend."""
+        bk = bk or B.get_backend()
+        return bk.coset_lde_many(
+            coeffs_list, self.omega_ext, COSET_GEN, self.n_ext,
+            powers=self._coset_powers(COSET_GEN, bk))
+
     def _coset_powers(self, gen: int, bk) -> np.ndarray:
         """Per-domain cache of [g^0..g^(4n-1)]: recomputing the serial power
         chain per coeff_to_extended call was ~0.3s x ~90 calls per prove."""

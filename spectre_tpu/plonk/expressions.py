@@ -244,6 +244,47 @@ def sha_expressions(cfg: CircuitConfig, c):
 
 
 
+class _KeyRecorder:
+    """Null context that records every `var` key the expression stream
+    reads — the PREFETCH PLAN for batched coset-LDE (ISSUE 4): the device
+    quotient extends all referenced columns through one batched fused
+    kernel up front instead of a lazy per-column dispatch per first read.
+    Every op returns an opaque token; the tree's structure depends only on
+    cfg, never on values, so recording is exact and costs no arithmetic."""
+
+    l0 = llast = lblind = x_col = None
+
+    def __init__(self):
+        self.keys: dict = {}          # insertion-ordered de-dup
+
+    def var(self, key, rot):
+        self.keys[key] = None
+        return None
+
+    def mul(self, a, b):
+        return None
+
+    add = sub = mul
+
+    def scale(self, a, s):
+        return None
+
+    add_const = scale
+
+    def const(self, s):
+        return None
+
+
+def referenced_keys(cfg: CircuitConfig) -> list:
+    """Ordered, de-duplicated column keys `all_expressions` reads for this
+    config (beta/gamma only enter as scale/add_const constants, so any
+    values work). Used by quotient_device's batched prefetch."""
+    rec = _KeyRecorder()
+    for _ in all_expressions(cfg, rec, 1, 1):
+        pass
+    return list(rec.keys)
+
+
 class ScalarCtx:
     """Verifier-side: everything is an int mod R; vars come from proof evals."""
 

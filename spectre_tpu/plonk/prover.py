@@ -142,10 +142,12 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
     def commit_cols_batched(item_list):
         """Pipelined + batched commits (SURVEY §2c axes (b)+(c)): host limb
         marshalling of the NEXT chunk overlaps the backend NTT+MSM of the
-        current one on worker threads (ctypes/JAX release the GIL), and each
-        chunk's MSMs go through one `commit_many` call (device base cached;
-        batch axis sharded on a mesh). Transcript order is unchanged —
-        points are absorbed strictly in sequence."""
+        current one on worker threads (ctypes/JAX release the GIL), each
+        chunk's iNTTs run as ONE batched `lagrange_to_coeff_many` call
+        (ISSUE 4: a single [B, n, 16] device kernel instead of B per-column
+        dispatches), and each chunk's MSMs go through one `commit_many`
+        call (device base cached; batch axis sharded on a mesh). Transcript
+        order is unchanged — points are absorbed strictly in sequence."""
         from concurrent.futures import ThreadPoolExecutor
 
         if not item_list:
@@ -159,13 +161,12 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
                                min(base + 2 * COMMIT_CHUNK, len(item_list))):
                     if j not in futs:
                         futs[j] = ex.submit(B.to_arr, item_list[j][1])
-                coeffs = []
-                for off, (key, vals) in enumerate(chunk):
-                    arr = futs.pop(base + off).result()
-                    c = dom.lagrange_to_coeff(arr, bk)
+                arrs = [futs.pop(base + off).result()
+                        for off in range(len(chunk))]
+                coeffs = dom.lagrange_to_coeff_many(arrs, bk)
+                for (key, vals), c in zip(chunk, coeffs):
                     values[key] = vals
                     polys[key] = c
-                    coeffs.append(c)
                 for pt in kzg.commit_many(srs, coeffs, bk):
                     tr.write_point(pt)
 
@@ -256,9 +257,10 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
 
     # instance polys (public-input binding in the identity) — both quotient
     # paths and nothing else create them, so hoist before the dispatch
-    for j in range(cfg.num_instance):
-        polys[("inst", j)] = dom.lagrange_to_coeff(
-            B.to_arr(inst_vals[j]), bk)
+    # (one batched iNTT over the instance-column stack)
+    for j, c in enumerate(dom.lagrange_to_coeff_many(
+            [B.to_arr(v) for v in inst_vals], bk)):
+        polys[("inst", j)] = c
 
     def poly_for(key):
         kind, j = key

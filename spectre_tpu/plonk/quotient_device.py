@@ -8,13 +8,22 @@ a [4n, 16] Montgomery tensor; the expression tree, the y-fold, the vanishing
 division, and the inverse coset NTT all run as device ops with no host
 round-trips between them.
 
+ISSUE 4: the per-column `to_ext` dispatch is now a BATCHED FUSED prefetch —
+the expression tree's column keys are enumerated up front
+(`expressions.referenced_keys`), stacked in fixed-size chunks, and extended
+through ONE compiled kernel per chunk (`ops/ntt.py:coset_lde_std`: the
+std→mont conversion and the coset pre-scale fold into stage 0 of the
+batched NTT, honoring SPECTRE_NTT_MODE). The inverse path folds the 1/n
+iNTT scale, the g^{-i} coset unscale and the mont→std boundary into one
+table multiply (`coset_intt_std`).
+
 Design note (learned the hard way): tracing the WHOLE tree into one jitted
 XLA program blows up LLVM codegen on the CPU backend (`Cannot allocate
 memory` from the execution engine at ~6k fused scan-heavy ops). The ops are
 therefore dispatched EAGERLY through a small set of jitted primitives
-(mont mul/add/sub, NTT) — data residency, not mega-fusion, is where the
-device win lives (each op is HBM-bandwidth-bound either way), and compile
-cost stays bounded per primitive shape.
+(mont mul/add/sub, batched NTT) — data residency, not mega-fusion, is where
+the device win lives (each op is HBM-bandwidth-bound either way), and
+compile cost stays bounded per primitive shape.
 
 Parity: the device path produces EXACTLY the host path's u64 coefficient
 arrays, compared in-situ during real proves
@@ -28,7 +37,7 @@ import numpy as np
 from ..fields import bn254
 from .constraint_system import CircuitConfig
 from .domain import COSET_GEN, Domain
-from .expressions import all_expressions
+from .expressions import all_expressions, referenced_keys
 from .keygen import ROT_LAST
 
 R = bn254.R
@@ -40,11 +49,9 @@ _static_cache: dict = {}
 def _helpers():
     """Jitted primitive ops, created once (stable trace cache)."""
     if not _jit_helpers:
-        import functools
-
         import jax
 
-        from ..ops import field_ops as F, ntt as NTT
+        from ..ops import field_ops as F
 
         fctx = F.fr_ctx()
         _jit_helpers["to_mont"] = jax.jit(lambda v: F.to_mont(fctx, v))
@@ -58,20 +65,15 @@ def _helpers():
             lambda a, s: F.add(fctx, a, s[None, :].repeat(a.shape[0], 0)))
         _jit_helpers["fold"] = jax.jit(
             lambda acc, y, e: F.add(fctx, F.mont_mul(fctx, acc, y[None, :]), e))
-
-        @functools.partial(jax.jit, static_argnums=(2,))
-        def to_ext(coeffs16, coset_pow, omega_ext):
-            return NTT.ntt(F.mont_mul(fctx, coeffs16, coset_pow), omega_ext)
-
-        _jit_helpers["to_ext"] = to_ext
-
-        @functools.partial(jax.jit, static_argnums=(3,))
-        def h_from_acc(acc, vinv, inv_coset, omega_ext):
-            h = F.mont_mul(fctx, acc, vinv)
-            return F.mont_mul(fctx, NTT.intt(h, omega_ext), inv_coset)
-
-        _jit_helpers["h_from_acc"] = h_from_acc
     return _jit_helpers
+
+
+# columns per batched coset-LDE prefetch chunk: fixed so the [B, 4n, 16]
+# kernel compiles once per domain, capped by transient bytes (chunk * 4n *
+# 16 u32 lanes) so huge extended domains don't spike device memory
+def _ext_chunk(m: int) -> int:
+    cap = max(1, (256 << 20) // (m * 16 * 4))
+    return min(8, 1 << (cap.bit_length() - 1))
 
 
 class _DeviceCtx:
@@ -152,8 +154,12 @@ def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
             _scalar_cache[v] = mont_of([v])[0]
         return _scalar_cache[v]
 
-    # per-(cfg, domain) static device inputs: synthetic rows, coset scaling
-    # vectors, x column, vanishing inverse — built once, reused every proof
+    from ..ops import ntt as NTT
+
+    # per-(cfg, domain) static device inputs: synthetic rows, x column,
+    # vanishing inverse — built once, reused every proof (the coset scale /
+    # unscale tables now live inside ops/ntt.py's budgeted table LRU as
+    # part of the fused kernels)
     n, m = dom.n, dom.n_ext
     ck = (cfg, dom.k)
     st = _static_cache.get(ck)
@@ -165,9 +171,6 @@ def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
             return dom.lagrange_to_coeff(B.to_arr(vals))
 
         st = {
-            "coset_pow": mont_of([pow(COSET_GEN, i, R) for i in range(m)]),
-            "inv_coset": mont_of(
-                [pow(pow(COSET_GEN, -1, R), i, R) for i in range(m)]),
             "xcol": mont_of([COSET_GEN * pow(dom.omega_ext, i, R) % R
                              for i in range(m)]),
             "vinv": to_mont16(jnp.asarray(L16.u64limbs_to_u16limbs(
@@ -180,22 +183,46 @@ def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
             _static_cache.clear()
         _static_cache[ck] = st
 
-    def ext_of_coeffs(arr_u64):
-        padded = np.zeros((m, 4), dtype=np.uint64)
-        padded[:arr_u64.shape[0]] = arr_u64
-        return h["to_ext"](
-            to_mont16(jnp.asarray(L16.u64limbs_to_u16limbs(padded))),
-            st["coset_pow"], dom.omega_ext)
+    def ext_of_many(arrs_u64):
+        """Batched fused coset-LDE of a coefficient-array list: ONE
+        compiled [B, 4n, 16] kernel (std→mont + g^i scale fused into
+        stage 0; SPECTRE_NTT_MODE selects radix2/fourstep)."""
+        b = len(arrs_u64)
+        stack = np.zeros((b, m, 4), dtype=np.uint64)
+        for i, cf in enumerate(arrs_u64):
+            stack[i, :cf.shape[0]] = cf
+        std16 = L16.u64limbs_to_u16limbs(stack.reshape(-1, 4)).reshape(
+            b, m, 16)
+        out = NTT.coset_lde_std(jnp.asarray(std16), dom.omega_ext,
+                                COSET_GEN)
+        return [out[i] for i in range(b)]
 
-    # lazily materialize only the columns the tree actually reads
+    def ext_of_coeffs(arr_u64):
+        return ext_of_many([arr_u64])[0]
+
+    # synthetic rows extend as one batched call; real columns prefetch in
+    # fixed-size chunks enumerated from the expression tree
+    l0_e, llast_e, lblind_e = ext_of_many(
+        [st["l0"], st["llast"], st["lblind"]])
     cols: dict = {
-        ("_l0",): ext_of_coeffs(st["l0"]),
-        ("_llast",): ext_of_coeffs(st["llast"]),
-        ("_lblind",): ext_of_coeffs(st["lblind"]),
+        ("_l0",): l0_e,
+        ("_llast",): llast_e,
+        ("_lblind",): lblind_e,
         ("_xcol",): st["xcol"],
     }
+    plan = [k for k in referenced_keys(cfg) if k not in cols]
+    chunk_sz = _ext_chunk(m)
+    for base in range(0, len(plan), chunk_sz):
+        chunk = plan[base:base + chunk_sz]
+        # pad the tail chunk with the first key so the kernel sees one
+        # batch shape per domain (duplicates are free — same NTT, sliced)
+        padded = chunk + [chunk[0]] * (chunk_sz - len(chunk))
+        outs = ext_of_many([fetch_coeffs(k) for k in padded])
+        for k_, o in zip(chunk, outs):
+            cols[k_] = o
 
     class LazyCols(dict):
+        # safety net: any key the recorder missed still materializes
         def __missing__(self, key):
             arr = ext_of_coeffs(fetch_coeffs(key))
             self[key] = arr
@@ -209,6 +236,8 @@ def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
     if acc is None:
         raise ValueError("config yields no constraint expressions — "
                          "nothing to fold into a quotient")
-    out = h["h_from_acc"](acc, st["vinv"], st["inv_coset"], dom.omega_ext)
-    std = h["from_mont"](out)
+    # h = acc / Z_H on the coset, then the fused inverse path: ONE kernel
+    # (iNTT + combined g^{-i}·n^{-1} unscale + mont→std boundary table)
+    hacc = h["mul"](acc, st["vinv"])
+    std = NTT.coset_intt_std(hacc, dom.omega_ext, COSET_GEN)
     return L16.u16limbs_to_u64limbs(np.asarray(std))

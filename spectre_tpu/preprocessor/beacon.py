@@ -79,6 +79,24 @@ def _retry_after_seconds(exc: BaseException) -> float | None:
         return None     # HTTP-date form: fall back to computed backoff
 
 
+# live-client registry for readiness reporting: GET /healthz consults the
+# breaker state of every BeaconClient this process created (weak refs — a
+# dropped client leaves the registry; no lifecycle coupling to the service)
+import weakref
+
+_CLIENTS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def breaker_snapshot() -> list[dict]:
+    """Breaker state of every live BeaconClient, for /healthz readiness
+    (ROADMAP PR-3 follow-up): an OPEN breaker means the upstream beacon is
+    considered down and the service cannot make proving progress that
+    needs fresh chain data — the readiness probe turns 503."""
+    return [{"base_url": c.base_url, "state": c.breaker_state,
+             "consecutive_failures": c._consecutive_failures}
+            for c in list(_CLIENTS)]
+
+
 class BeaconClient:
     def __init__(self, base_url: str, timeout: float = 30.0,
                  retries: int | None = None,
@@ -114,6 +132,7 @@ class BeaconClient:
         self._consecutive_failures = 0
         self._opened_at: float | None = None
         self._half_open = False
+        _CLIENTS.add(self)     # readiness registry (breaker_snapshot)
 
     # -- circuit breaker ---------------------------------------------------
 

@@ -49,6 +49,16 @@ JOURNAL_NAME = "jobs.journal.jsonl"
 # terminal states never transition again; "queued"/"running" are live
 TERMINAL = ("done", "failed", "cancelled")
 
+# startup-replay compaction trigger: past this size the journal is
+# rewritten keeping only the terminal-state tail per job (ISSUE 4 /
+# ROADMAP PR-3 follow-up: the JSONL otherwise grows unbounded)
+COMPACT_ENV = "SPECTRE_JOURNAL_COMPACT_BYTES"
+COMPACT_DEFAULT_BYTES = 4 << 20
+
+
+def _compact_threshold() -> int:
+    return int(os.environ.get(COMPACT_ENV, str(COMPACT_DEFAULT_BYTES)))
+
 
 def witness_digest(method: str, params: dict) -> str:
     """Canonical digest of a proof request — the dedup key."""
@@ -153,6 +163,57 @@ class JobJournal:
                     job.finished_at = rec.get("ts")
         return jobs
 
+    def size(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def compact(self, jobs):
+        """Rewrite the JSONL keeping only the terminal-state tail per job:
+        one `submit` record plus (for terminal jobs) the final event —
+        every intermediate running/requeued transition is dropped. Done
+        jobs keep their results so a restarted service still serves them.
+
+        Crash-safe: the replacement is written to a sidecar file, fsync'd,
+        and atomically `os.replace`d over the journal — a crash mid-compact
+        (fault site `journal.compact`, fired after the rewrite is staged
+        but before the swap) leaves the ORIGINAL journal untouched and the
+        next startup simply re-compacts."""
+        tmp = self.path + ".compact"
+        with self._lock:
+            with open(tmp, "w") as f:
+                for job in sorted(jobs, key=lambda j: j.submitted_at):
+                    recs = [{"event": "submit", "job_id": job.id,
+                             "method": job.method, "params": job.params,
+                             "digest": job.digest, "timeout": job.timeout,
+                             "ts": job.submitted_at}]
+                    if job.status in TERMINAL:
+                        rec = {"event": job.status, "job_id": job.id,
+                               "ts": job.finished_at}
+                        if job.result is not None:
+                            rec["result"] = job.result
+                        if job.error is not None:
+                            rec["error"] = job.error
+                        recs.append(rec)
+                    for rec in recs:
+                        f.write(json.dumps(rec, sort_keys=True,
+                                           separators=(",", ":")) + "\n")
+                f.flush()
+                # crash window: sidecar staged, original journal intact
+                faults.check("journal.compact")
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            # fsync the directory so the rename survives power loss
+            try:
+                dfd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                pass
+
 
 class JobQueue:
     """Bounded async worker pool over a `runner(method, params)` callback.
@@ -211,6 +272,18 @@ class JobQueue:
                 self._q.put(job.id)
         if replayed:
             self.health.incr("journal_replays")
+        # startup compaction: replay (plus its requeue appends) is the one
+        # moment the full job map is authoritative and no workers write
+        if self.journal.size() > _compact_threshold():
+            try:
+                self.journal.compact(list(self._jobs.values()))
+                self.health.incr("journal_compactions")
+            except faults.InjectedCrash:
+                raise          # simulated death mid-compact (tests)
+            except Exception:
+                # a failed compaction costs disk, never correctness: the
+                # original journal is still the source of truth
+                self.health.incr("journal_compact_failures")
 
     # -- journal helper ----------------------------------------------------
 

@@ -133,9 +133,20 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path not in ("/healthz", "/health"):
             self.send_error(404)
             return
+        from ..preprocessor.beacon import breaker_snapshot
         snap = HEALTH.snapshot()
-        snap["status"] = "ok"
         snap["jobs"] = self.jobs.stats() if self.jobs is not None else {}
+        breakers = breaker_snapshot()
+        snap["beacon_breakers"] = breakers
+        # readiness (ROADMAP PR-3 follow-up): an OPEN beacon circuit
+        # breaker means the upstream is considered down — report 503 so
+        # orchestrators stop routing, with the counters in the body for
+        # the operator. half-open admits a trial request, so it is ready.
+        if any(b["state"] == "open" for b in breakers):
+            snap["status"] = "degraded"
+            self._reply(snap, status=503)
+            return
+        snap["status"] = "ok"
         self._reply(snap)
 
     def do_POST(self):
@@ -213,8 +224,10 @@ class _Handler(BaseHTTPRequestHandler):
         elif method == "cancelProof":
             result = {"cancelled": self.jobs.cancel(params["job_id"])}
         elif method == "health":
+            from ..preprocessor.beacon import breaker_snapshot
             result = HEALTH.snapshot()
             result["jobs"] = self.jobs.stats() if self.jobs else {}
+            result["beacon_breakers"] = breaker_snapshot()
         elif method == "ping":
             result = "pong"
         else:

@@ -432,7 +432,52 @@ class TestAsyncRPC:
                                  "method": "health", "params": {}},
                           timeout=60)["result"]
             assert "counters" in h
+            assert "beacon_breakers" in h
         finally:
+            server.shutdown()
+
+    def test_healthz_not_ready_when_breaker_open(self):
+        """ROADMAP PR-3 follow-up (ISSUE 4 satellite): an OPEN beacon
+        circuit breaker turns the readiness probe into a 503 with the
+        breaker state in the body; once the breaker leaves the open state
+        (cooldown -> half-open trial) readiness returns to 200."""
+        import time
+        import urllib.error
+
+        from spectre_tpu.preprocessor.beacon import (BeaconClient,
+                                                     CircuitBreakerOpen)
+        from spectre_tpu.prover_service.rpc import serve
+        from spectre_tpu.utils import faults
+        state = _FakeState(TINY)
+        server = serve(state, port=0, background=True)
+        port = server.server_address[1]
+        client = BeaconClient("http://127.0.0.1:9/", retries=0,
+                              breaker_threshold=1, breaker_cooldown=0.2,
+                              total_timeout=5.0, sleep=lambda _s: None)
+        try:
+            faults.install_plan("beacon.fetch:connreset:1")
+            # threshold=1: the injected failure trips the breaker mid-call
+            with pytest.raises(CircuitBreakerOpen):
+                client._get("/eth/v1/anything")
+            assert client.breaker_state == "open"
+            req = urllib.request.Request(f"http://127.0.0.1:{port}/healthz")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=60)
+            assert e.value.code == 503
+            body = json.load(e.value)
+            assert body["status"] == "degraded"
+            assert any(b["state"] == "open"
+                       for b in body["beacon_breakers"])
+            # cooldown elapses -> half-open admits a trial -> ready again
+            time.sleep(0.25)
+            assert client.breaker_state == "half-open"
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=60) as resp:
+                data = json.load(resp)
+            assert data["status"] == "ok"
+        finally:
+            faults.clear()
+            del client
             server.shutdown()
 
 
