@@ -10,12 +10,15 @@ native:
 test: native lint test-faults bench-fast
 	python -m pytest tests/ -q
 
-# fault-injection tier (PR 3): deterministic resilience suite — beacon
-# retry/backoff + circuit breaker, device-prove -> CPU fallback
-# byte-equality, job-journal crash replay, MSM table-budget degrade.
-# Seconds-scale on tiny specs; also part of the full pytest ladder above.
+# fault-injection tier (PR 3, grown in PR 6): deterministic resilience
+# suite — beacon retry/backoff + circuit breaker, device-prove -> CPU
+# fallback byte-equality, job-journal crash replay, MSM table-budget
+# degrade, admission-control shed/recover, stalled-worker replacement
+# (injectable clock keeps it seconds-scale), artifact-store quarantine,
+# SRS checksum refusal, overload RPC contract (429/-32001/Retry-After).
+# Also part of the full pytest ladder above.
 test-faults: native
-	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py tests/test_service.py -q
 
 test-slow: native
 	RUN_SLOW=1 python -m pytest tests/ -q

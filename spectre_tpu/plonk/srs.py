@@ -93,16 +93,29 @@ class SRS:
             f.write(self.g1_powers.astype("<u8").tobytes())
             f.write(bn254.g2_to_bytes(self.g2_gen))
             f.write(bn254.g2_to_bytes(self.g2_tau))
+        # integrity sidecar (ISSUE 6): <path>.sha256 lets `read` detect a
+        # bit-flipped params file as a typed ArtifactCorrupt at load time
+        # instead of a deep keygen/prove blow-up hours later
+        from ..utils import artifacts
+        artifacts.write_sidecar(path)
 
     @classmethod
-    def read(cls, path: str) -> "SRS":
+    def read(cls, path: str, verify: bool = True) -> "SRS":
+        from ..utils import artifacts
         with open(path, "rb") as f:
-            magic = f.read(8)
-            assert magic == b"SPTSRS02", \
-                "bad/stale SRS file (tau derivation changed in SPTSRS02; delete the params dir)"
-            k = int.from_bytes(f.read(4), "little")
-            n = 1 << k
-            g1 = np.frombuffer(f.read(n * 8 * 8), dtype="<u8").reshape(n, 8).copy()
-            g2_gen = bn254.g2_from_bytes(f.read(128))
-            g2_tau = bn254.g2_from_bytes(f.read(128))
+            raw = f.read()
+        if verify:
+            # a MISSING sidecar stays loadable (pre-checksum params dirs);
+            # a mismatching one refuses with a typed ArtifactCorrupt
+            artifacts.verify_sidecar(path, raw)
+        assert raw[:8] == b"SPTSRS02", \
+            "bad/stale SRS file (tau derivation changed in SPTSRS02; delete the params dir)"
+        k = int.from_bytes(raw[8:12], "little")
+        n = 1 << k
+        off = 12
+        g1 = np.frombuffer(raw[off:off + n * 8 * 8],
+                           dtype="<u8").reshape(n, 8).copy()
+        off += n * 8 * 8
+        g2_gen = bn254.g2_from_bytes(raw[off:off + 128])
+        g2_tau = bn254.g2_from_bytes(raw[off + 128:off + 256])
         return cls(k, g1, g2_gen, g2_tau)

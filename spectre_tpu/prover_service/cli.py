@@ -58,6 +58,17 @@ def main(argv=None):
     r.add_argument("--job-timeout", type=float, default=None,
                    help="default per-job deadline in seconds for async "
                    "submitProof_* jobs (default: none)")
+    r.add_argument("--queue-depth", type=int, default=None,
+                   help="admission-control backlog bound; a full queue "
+                   "sheds submits with -32001/429 + Retry-After "
+                   "(default: $SPECTRE_JOB_QUEUE_DEPTH or 64)")
+    r.add_argument("--mem-watermark-mb", type=float, default=None,
+                   help="shed NEW submissions once RSS exceeds this "
+                   "(default: $SPECTRE_MEM_WATERMARK_MB; 0 disables)")
+    r.add_argument("--worker-stall-s", type=float, default=None,
+                   help="supervisor stall threshold: a worker whose "
+                   "heartbeat is older than this is replaced and its job "
+                   "failed (default: $SPECTRE_WORKER_STALL_S or 600)")
 
     u = sub.add_parser("utils", help="deployment utilities")
     u.add_argument("util", choices=["committee-poseidon"])
@@ -83,7 +94,15 @@ def main(argv=None):
               f"(async jobs journaled under "
               f"{args.params_dir or 'params_dir unset: in-memory only'})",
               flush=True)
-        serve(state, args.host, args.port, job_timeout=args.job_timeout)
+        queue_kw = {}
+        if args.queue_depth is not None:
+            queue_kw["queue_depth"] = args.queue_depth
+        if args.mem_watermark_mb is not None:
+            queue_kw["mem_watermark_mb"] = args.mem_watermark_mb
+        if args.worker_stall_s is not None:
+            queue_kw["stall_timeout"] = args.worker_stall_s
+        serve(state, args.host, args.port, job_timeout=args.job_timeout,
+              **queue_kw)
     elif args.cmd == "utils":
         _utils_cmd(args, spec)
     elif args.cmd == "bench":

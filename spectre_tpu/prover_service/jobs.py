@@ -26,15 +26,42 @@ deadline passes (observed by pollers and by the worker), and the eventual
 runner result is discarded. Cancellation works the same way for running
 jobs and dequeues queued ones outright.
 
-Fault-injection site: `journal.write` (utils/faults) fires inside the
-append path so CI can prove that a journal-write failure fails the job
-rather than wedging the queue.
+Overload + integrity layer (ISSUE 6):
+
+* **Admission control** — the queue is bounded (`SPECTRE_JOB_QUEUE_DEPTH`,
+  default 64): a full backlog rejects new submissions with a typed
+  :class:`ServiceOverloaded` carrying `retry_after_s` (derived from the
+  observed mean prove latency on ServiceHealth) instead of buffering
+  unboundedly. A host-memory watermark (`SPECTRE_MEM_WATERMARK_MB`,
+  psutil-free `/proc/self/statm`; graceful no-op off-Linux) sheds NEW work
+  before the box OOMs. Counters: `jobs_shed_queue` / `jobs_shed_memory`.
+* **Deadline propagation** — a client-supplied `deadline_s` clamps the
+  per-job timeout at submit time.
+* **Worker supervision** — workers stamp a monotonic heartbeat between
+  prove phases (a `heartbeat` callback threaded through the runner into
+  `ProverState.prove_*`); a supervisor thread detects a worker stalled
+  past `SPECTRE_WORKER_STALL_S`, marks its job `failed(stalled)`, spawns
+  a replacement worker for the slot (the hung thread is disowned — on an
+  eventual return it notices it lost its slot and exits) and bumps
+  `workers_replaced`. The supervisor only does bookkeeping: it NEVER
+  proves inline (the non-reentrant `state.semaphore` rule).
+* **Artifact offload** — proof results live in an integrity-checked
+  content-addressed store (utils/artifacts) under
+  `params_dir/results/<sha256>.bin`; the journal records the digest, not
+  the payload, so it stays O(#jobs). Replay re-verifies digests and
+  quarantines corrupt files (the job degrades to failed + re-provable)
+  instead of serving poison.
+
+Fault-injection sites: `journal.write` fires inside the append path so CI
+can prove a journal-write failure fails the job rather than wedging the
+queue; `artifact.write`/`artifact.read` cover the result store.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import hashlib
+import inspect
 import json
 import os
 import queue
@@ -42,9 +69,20 @@ import threading
 import time
 
 from ..utils import faults
+from ..utils.artifacts import ArtifactCorrupt, ArtifactStore
 from ..utils.health import HEALTH
 
 JOURNAL_NAME = "jobs.journal.jsonl"
+
+# admission control (ISSUE 6): bound the backlog, shed before the box OOMs
+QUEUE_DEPTH_ENV = "SPECTRE_JOB_QUEUE_DEPTH"
+QUEUE_DEPTH_DEFAULT = 64
+MEM_WATERMARK_ENV = "SPECTRE_MEM_WATERMARK_MB"      # 0 / unset = disabled
+WORKER_STALL_ENV = "SPECTRE_WORKER_STALL_S"
+WORKER_STALL_DEFAULT_S = 600.0
+
+# retry_after_s fallback when no prove has completed yet (nothing observed)
+DEFAULT_PROVE_LATENCY_S = 30.0
 
 # terminal states never transition again; "queued"/"running" are live
 TERMINAL = ("done", "failed", "cancelled")
@@ -58,6 +96,35 @@ COMPACT_DEFAULT_BYTES = 4 << 20
 
 def _compact_threshold() -> int:
     return int(os.environ.get(COMPACT_ENV, str(COMPACT_DEFAULT_BYTES)))
+
+
+def _env_num(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v else default
+
+
+def rss_mb() -> float | None:
+    """Resident set size in MB via /proc/self/statm (no psutil). Returns
+    None where procfs is unavailable (macOS CI etc.) — the memory
+    watermark then degrades to a no-op rather than a crash."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE") / (1024.0 * 1024.0)
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+class ServiceOverloaded(RuntimeError):
+    """Load shed: the submission was REJECTED (queue full / memory
+    watermark), not queued. Carries the backoff hint the RPC layer turns
+    into `-32001` + HTTP 429 `Retry-After`."""
+
+    def __init__(self, reason: str, retry_after_s: float):
+        super().__init__(f"service overloaded ({reason}); "
+                         f"retry after {retry_after_s:.1f}s")
+        self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 def witness_digest(method: str, params: dict) -> str:
@@ -80,6 +147,7 @@ class Job:
     timeout: float | None = None
     attempts: int = 0
     result: dict | None = None
+    result_digest: str | None = None    # sha256 of the offloaded artifact
     error: dict | None = None
     cancel_requested: bool = False
 
@@ -152,7 +220,10 @@ class JobJournal:
                     job.started_at = None
                 elif ev == "done":
                     job.status = "done"
+                    # post-offload records carry the artifact digest; the
+                    # inline form stays readable (pre-ISSUE-6 journals)
                     job.result = rec.get("result")
+                    job.result_digest = rec.get("result_digest")
                     job.finished_at = rec.get("ts")
                 elif ev == "failed":
                     job.status = "failed"
@@ -191,7 +262,12 @@ class JobJournal:
                     if job.status in TERMINAL:
                         rec = {"event": job.status, "job_id": job.id,
                                "ts": job.finished_at}
-                        if job.result is not None:
+                        # journal slimming (ISSUE 6): an offloaded result
+                        # compacts to its digest — NEVER re-inline the
+                        # payload, the journal must stay O(#jobs)
+                        if job.result_digest is not None:
+                            rec["result_digest"] = job.result_digest
+                        elif job.result is not None:
                             rec["result"] = job.result
                         if job.error is not None:
                             rec["error"] = job.error
@@ -228,27 +304,71 @@ class JobQueue:
 
     def __init__(self, runner, concurrency: int = 1,
                  journal_dir: str | None = None, semaphore=None,
-                 default_timeout: float | None = None, health=HEALTH):
+                 default_timeout: float | None = None, health=HEALTH,
+                 queue_depth: int | None = None,
+                 mem_watermark_mb: float | None = None,
+                 stall_timeout: float | None = None,
+                 clock=time.monotonic, sleep_interval: float | None = None):
+        """`queue_depth`/`mem_watermark_mb`/`stall_timeout` default to the
+        SPECTRE_JOB_QUEUE_DEPTH / SPECTRE_MEM_WATERMARK_MB /
+        SPECTRE_WORKER_STALL_S env knobs. `clock` and `sleep_interval` are
+        the supervisor's injectable time source and scan period (the
+        BeaconClient pattern: stall tests run deterministic + fast)."""
         self.runner = runner
         self.concurrency = max(1, int(concurrency))
         self.semaphore = semaphore
         self.default_timeout = default_timeout
         self.health = health
         self.journal = JobJournal(journal_dir) if journal_dir else None
+        self.store = ArtifactStore(journal_dir, health=health) \
+            if journal_dir else None
+        self.queue_depth = int(queue_depth if queue_depth is not None
+                               else _env_num(QUEUE_DEPTH_ENV,
+                                             QUEUE_DEPTH_DEFAULT))
+        self.mem_watermark_mb = float(
+            mem_watermark_mb if mem_watermark_mb is not None
+            else _env_num(MEM_WATERMARK_ENV, 0.0))
+        self.stall_timeout = float(
+            stall_timeout if stall_timeout is not None
+            else _env_num(WORKER_STALL_ENV, WORKER_STALL_DEFAULT_S))
+        self._clock = clock
         self._jobs: dict[str, Job] = {}
         self._by_digest: dict[str, str] = {}
         self._q: queue.Queue = queue.Queue()
         self._cv = threading.Condition()
         self._seq = 0
         self._stopped = False
+        self._stop_event = threading.Event()
+        # does the runner accept a heartbeat callback? (inspected once —
+        # plain runner(method, params) callables keep working unchanged)
+        self._runner_heartbeat = _accepts_heartbeat(runner)
         if self.journal is not None:
             self._recover()
-        self._workers = [
-            threading.Thread(target=self._worker_loop, daemon=True,
-                             name=f"prover-job-worker-{i}")
-            for i in range(self.concurrency)]
-        for t in self._workers:
-            t.start()
+        # per-slot worker bookkeeping: the supervisor compares each slot's
+        # heartbeat against `clock()` and replaces the thread on stall
+        self._slots = [{"thread": None, "beat": self._clock(), "job": None}
+                       for _ in range(self.concurrency)]
+        for i in range(self.concurrency):
+            self._spawn_worker(i)
+        self._supervisor = threading.Thread(
+            target=self._supervise_loop, daemon=True,
+            name="prover-job-supervisor",
+            args=(sleep_interval if sleep_interval is not None
+                  else max(0.05, min(self.stall_timeout / 4.0, 1.0)),))
+        self._supervisor.start()
+
+    @property
+    def _workers(self):
+        """Live worker threads (legacy-test compat view over the slots)."""
+        return [s["thread"] for s in self._slots if s["thread"] is not None]
+
+    def _spawn_worker(self, slot: int):
+        t = threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"prover-job-worker-{slot}",
+                             args=(slot,))
+        self._slots[slot]["thread"] = t
+        self._slots[slot]["beat"] = self._clock()
+        t.start()
 
     # -- recovery ----------------------------------------------------------
 
@@ -256,6 +376,15 @@ class JobQueue:
         replayed = self.journal.replay()
         for job in replayed.values():
             self._jobs[job.id] = job
+            # restore the id counter past every replayed job: a fresh
+            # submission after restart must never mint a colliding id
+            # (which would silently OVERWRITE the replayed record)
+            try:
+                self._seq = max(self._seq, int(job.id.rsplit("-", 1)[1]))
+            except (IndexError, ValueError):
+                pass
+            if job.status == "done":
+                self._resolve_result(job)
             # last submit wins the digest slot; terminal-but-failed jobs
             # stay resubmittable (dedup only pins live/done jobs)
             if job.status not in ("failed", "cancelled"):
@@ -285,6 +414,29 @@ class JobQueue:
                 # original journal is still the source of truth
                 self.health.incr("journal_compact_failures")
 
+    def _resolve_result(self, job: Job):
+        """Re-hydrate a done job's result from the artifact store,
+        RE-VERIFYING the digest. A corrupt artifact is quarantined (by
+        the store) and the job degrades to failed — its digest slot is
+        not pinned, so a resubmission simply re-proves."""
+        if job.result is not None or job.result_digest is None:
+            return                       # inline (legacy) or nothing to do
+        if self.store is None:
+            job.status = "failed"
+            job.error = {"kind": "ArtifactCorrupt",
+                         "message": "result artifact store unavailable"}
+            return
+        try:
+            job.result = json.loads(self.store.read(job.result_digest))
+        except (ArtifactCorrupt, OSError, ValueError) as exc:
+            job.status = "failed"
+            job.error = _error_dict(exc)
+            try:
+                self._append({"event": "failed", "job_id": job.id,
+                              "error": job.error, "ts": time.time()})
+            except Exception:
+                self.health.incr("journal_write_failures")
+
     # -- journal helper ----------------------------------------------------
 
     def _append(self, record: dict):
@@ -293,9 +445,47 @@ class JobQueue:
 
     # -- submission / polling ---------------------------------------------
 
+    def retry_after_s(self) -> float:
+        with self._cv:
+            return self.retry_after_locked()
+
+    def _admit_locked(self, digest: str):
+        """Load-shedding gate (called with _cv held, AFTER the dedup
+        check — a retry of known work is free and never shed)."""
+        pending = sum(1 for j in self._jobs.values()
+                      if j.status == "queued")
+        if pending >= self.queue_depth:
+            self.health.incr("jobs_shed_queue")
+            raise ServiceOverloaded("queue full", self.retry_after_locked())
+        if self.mem_watermark_mb > 0:
+            rss = rss_mb()
+            if rss is not None and rss >= self.mem_watermark_mb:
+                self.health.incr("jobs_shed_memory")
+                raise ServiceOverloaded("memory watermark",
+                                        self.retry_after_locked())
+
+    def retry_after_locked(self) -> float:
+        """Backoff hint for shed submissions: the backlog ahead of a
+        retrying client, priced at the observed mean prove latency."""
+        mean = self.health.mean("prove_latency_s", DEFAULT_PROVE_LATENCY_S)
+        backlog = sum(1 for j in self._jobs.values()
+                      if j.status in ("queued", "running"))
+        est = mean * max(1.0, float(backlog)) / float(self.concurrency)
+        return round(min(max(est, 1.0), 600.0), 3)
+
     def submit(self, method: str, params: dict,
-               timeout: float | None = None) -> str:
+               timeout: float | None = None,
+               deadline_s: float | None = None) -> str:
+        """`deadline_s` (client-supplied) CLAMPS the effective per-job
+        timeout — a client that must answer its own caller in 60s gets a
+        job that gives up by then rather than burning a worker on a
+        result nobody will read. Raises :class:`ServiceOverloaded` when
+        admission control sheds the submission."""
         digest = witness_digest(method, params)
+        eff_timeout = timeout if timeout is not None else self.default_timeout
+        if deadline_s is not None:
+            eff_timeout = deadline_s if eff_timeout is None \
+                else min(eff_timeout, deadline_s)
         with self._cv:
             existing = self._by_digest.get(digest)
             if existing is not None:
@@ -304,12 +494,11 @@ class JobQueue:
                                                           "cancelled"):
                     self.health.incr("jobs_deduped")
                     return job.id
+            self._admit_locked(digest)
             self._seq += 1
             jid = f"{digest[:16]}-{self._seq:04d}"
             job = Job(id=jid, method=method, params=params, digest=digest,
-                      submitted_at=time.time(),
-                      timeout=(timeout if timeout is not None
-                               else self.default_timeout))
+                      submitted_at=time.time(), timeout=eff_timeout)
             self._jobs[jid] = job
             self._by_digest[digest] = jid
         try:
@@ -375,11 +564,13 @@ class JobQueue:
             counts: dict[str, int] = {}
             for job in self._jobs.values():
                 counts[job.status] = counts.get(job.status, 0) + 1
-            return {"jobs": counts, "workers": self.concurrency}
+            return {"jobs": counts, "workers": self.concurrency,
+                    "queue_depth": self.queue_depth}
 
     def stop(self):
         self._stopped = True
-        for _ in self._workers:
+        self._stop_event.set()
+        for _ in range(self.concurrency):
             self._q.put(None)
 
     # -- worker ------------------------------------------------------------
@@ -394,15 +585,21 @@ class JobQueue:
                                        f"{job.timeout}s timeout"})
             self.health.incr("jobs_timed_out")
 
-    def _finish_locked(self, job: Job, status: str, result=None, error=None):
+    def _finish_locked(self, job: Job, status: str, result=None, error=None,
+                       result_digest=None):
         job.status = status
         job.result = result
+        job.result_digest = result_digest
         job.error = error
         job.finished_at = time.time()
         self._cv.notify_all()
         try:
             rec = {"event": status, "job_id": job.id, "ts": job.finished_at}
-            if result is not None:
+            # offloaded results journal as their digest; the payload lives
+            # in the integrity-checked artifact store
+            if result_digest is not None:
+                rec["result_digest"] = result_digest
+            elif result is not None:
                 rec["result"] = result
             if error is not None:
                 rec["error"] = error
@@ -412,9 +609,26 @@ class JobQueue:
             # here only costs replay fidelity, never a wedged client
             self.health.incr("journal_write_failures")
 
-    def _worker_loop(self):
+    def _beat(self, slot: int, jid: str):
+        """Heartbeat stamp — called by the worker between prove phases
+        (threaded into the runner as a zero-arg callback)."""
+        s = self._slots[slot]
+        if s["job"] == jid:
+            s["beat"] = self._clock()
+
+    def _owns_slot(self, slot: int) -> bool:
+        return self._slots[slot]["thread"] is threading.current_thread()
+
+    def _worker_loop(self, slot: int):
         while True:
             jid = self._q.get()
+            # a replaced (previously stalled) worker that wakes back up
+            # has LOST its slot: put the item back and die quietly — the
+            # replacement thread owns the queue now
+            if not self._owns_slot(slot):
+                if jid is not None:
+                    self._q.put(jid)
+                return
             if jid is None or self._stopped:
                 return
             with self._cv:
@@ -425,6 +639,8 @@ class JobQueue:
                 job.started_at = time.time()
                 job.attempts += 1
                 attempt = job.attempts
+                self._slots[slot]["job"] = jid
+                self._slots[slot]["beat"] = self._clock()
             try:
                 self._append({"event": "running", "job_id": jid,
                               "attempt": attempt, "ts": job.started_at})
@@ -432,14 +648,22 @@ class JobQueue:
                 with self._cv:
                     self._finish_locked(job, "failed",
                                         error=_error_dict(exc))
+                    if self._slots[slot]["job"] == jid:
+                        self._slots[slot]["job"] = None
                 self.health.incr("journal_write_failures")
                 continue
             sem = self.semaphore
+            heartbeat = (lambda s=slot, j=jid: self._beat(s, j))
+            t0 = time.time()
             try:
                 if sem is not None:
                     sem.acquire()
                 try:
-                    result = self.runner(job.method, job.params)
+                    if self._runner_heartbeat:
+                        result = self.runner(job.method, job.params,
+                                             heartbeat=heartbeat)
+                    else:
+                        result = self.runner(job.method, job.params)
                 finally:
                     if sem is not None:
                         sem.release()
@@ -450,39 +674,122 @@ class JobQueue:
                 raise
             except Exception as exc:
                 with self._cv:
+                    if self._slots[slot]["job"] == jid:
+                        self._slots[slot]["job"] = None
+                    if not self._owns_slot(slot):
+                        return      # disowned: replacement took the slot
                     if job.status == "running":
                         self._finish_locked(job, "failed",
                                             error=_error_dict(exc))
                 self.health.incr("jobs_failed")
                 continue
+            # retry_after estimates feed on real observed latency
+            self.health.observe("prove_latency_s", time.time() - t0)
+            # offload the result OUTSIDE the lock (file IO); a write
+            # failure (fault site artifact.write) fails the job, never
+            # the queue
+            digest, offload_err = None, None
+            if self.store is not None and self.journal is not None:
+                try:
+                    digest = self.store.write(_result_blob(result))
+                except Exception as exc:
+                    offload_err = _error_dict(exc)
             with self._cv:
+                if self._slots[slot]["job"] == jid:
+                    self._slots[slot]["job"] = None
+                if not self._owns_slot(slot):
+                    # a stalled-then-returned worker: the supervisor
+                    # already failed this job and replaced us — discard
+                    # the late result and die without touching the slot
+                    return
                 if job.cancel_requested:
                     self._finish_locked(job, "cancelled")
                     continue
                 if job.status != "running":
                     continue                    # expired meanwhile: discard
-                self._finish_locked(job, "done", result=result)
+                if offload_err is not None:
+                    self._finish_locked(job, "failed", error=offload_err)
+                    self.health.incr("jobs_failed")
+                    continue
+                self._finish_locked(job, "done", result=result,
+                                    result_digest=digest)
             self.health.incr("jobs_done")
+
+    # -- supervision -------------------------------------------------------
+
+    def _supervise_loop(self, interval: float):
+        """Watchdog: a worker whose heartbeat is older than
+        `stall_timeout` while it owns a job is presumed hung (wedged
+        device call, deadlocked extension, ...). Python threads cannot be
+        killed, so the job is marked failed(stalled), the thread is
+        DISOWNED and a replacement takes over the slot. Bookkeeping only
+        — the supervisor never proves inline (state.semaphore is
+        non-reentrant)."""
+        while not self._stop_event.wait(interval):
+            if self._stopped:
+                return
+            now = self._clock()
+            for i, s in enumerate(self._slots):
+                jid = s["job"]
+                if jid is None or now - s["beat"] <= self.stall_timeout:
+                    continue
+                with self._cv:
+                    if self._slots[i]["job"] != jid:
+                        continue               # finished while we looked
+                    job = self._jobs.get(jid)
+                    if job is not None and job.status == "running":
+                        self._finish_locked(
+                            job, "failed",
+                            error={"kind": "StalledWorker",
+                                   "message":
+                                   f"worker heartbeat stalled > "
+                                   f"{self.stall_timeout}s; worker "
+                                   f"replaced"})
+                    self._slots[i]["job"] = None
+                    self._spawn_worker(i)      # disowns the hung thread
+                self.health.incr("workers_replaced")
 
 
 def _error_dict(exc: BaseException) -> dict:
     return {"kind": type(exc).__name__, "message": str(exc)}
 
 
+def _result_blob(result) -> bytes:
+    """Canonical bytes of a job result for the artifact store (the journal
+    records sha256 over exactly these)."""
+    return json.dumps(result, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def _accepts_heartbeat(fn) -> bool:
+    """Does this runner take a `heartbeat` callback? Inspected once at
+    queue construction; plain runner(method, params) callables keep
+    working unchanged."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    return any(p.name == "heartbeat" or p.kind == p.VAR_KEYWORD
+               for p in sig.parameters.values())
+
+
 def ensure_jobs(state, journal_dir: str | None = None, runner=None,
-                default_timeout: float | None = None) -> JobQueue:
+                default_timeout: float | None = None, **queue_kw) -> JobQueue:
     """Attach (once) a JobQueue to any prover-state-like object.
 
     Reuses `state.semaphore`/`state.concurrency` when present so the async
     queue and the blocking/batch paths share one concurrency cap. `runner`
-    defaults to the RPC proof dispatcher."""
+    defaults to the RPC proof dispatcher (heartbeat-aware: the worker's
+    stall-detection stamp threads through run_proof_method into
+    ProverState.prove_*). Extra `queue_kw` (queue_depth,
+    mem_watermark_mb, stall_timeout, ...) pass straight to JobQueue."""
     jobsq = getattr(state, "jobs", None)
     if jobsq is not None:
         return jobsq
     if runner is None:
         from .rpc import run_proof_method
-        runner = lambda method, params: run_proof_method(state, method,
-                                                         params)
+        runner = lambda method, params, heartbeat=None: run_proof_method(
+            state, method, params, heartbeat=heartbeat)
     # NOTE: no JobQueue-level semaphore here — the default runner goes
     # through state.prove_* which acquire state.semaphore THEMSELVES
     # (threading.Semaphore is not reentrant; acquiring at both layers
@@ -493,6 +800,6 @@ def ensure_jobs(state, journal_dir: str | None = None, runner=None,
         concurrency=getattr(state, "concurrency", 1),
         journal_dir=journal_dir if journal_dir is not None
         else getattr(state, "params_dir", None),
-        default_timeout=default_timeout)
+        default_timeout=default_timeout, **queue_kw)
     state.jobs = jobsq
     return jobsq

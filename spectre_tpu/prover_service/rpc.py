@@ -36,7 +36,7 @@ from ..preprocessor.rotation import rotation_args_from_update
 from ..preprocessor.step import step_args_from_finality_update
 from ..utils.health import HEALTH
 from .calldata import encode_calldata
-from .jobs import ensure_jobs
+from .jobs import ServiceOverloaded, ensure_jobs
 from .state import ProverState
 
 RPC_METHOD_STEP = "genEvmProof_SyncStepCompressed"
@@ -51,19 +51,35 @@ METHOD_NOT_FOUND = -32601
 INVALID_PARAMS = -32602
 INTERNAL_ERROR = -32603
 WITNESS_REJECTED = -32000
-JOB_NOT_DONE = -32001
+SERVICE_OVERLOADED = -32001     # load shed: carries data.retry_after_s
+JOB_NOT_DONE = -32002
 JOB_NOT_FOUND = -32004
 JOB_FAILED = -32005
 
 
-def _error(code, message, id_=None):
-    return {"jsonrpc": "2.0", "error": {"code": code, "message": message}, "id": id_}
+def _error(code, message, id_=None, data=None):
+    err = {"code": code, "message": message}
+    if data is not None:
+        err["data"] = data
+    return {"jsonrpc": "2.0", "error": err, "id": id_}
 
 
-def run_proof_method(state, method: str, params: dict) -> dict:
+def _prove_call(fn, args, heartbeat):
+    """Invoke a prove_* that may or may not accept the worker-supervision
+    heartbeat callback (duck-typed states in tests keep working)."""
+    from .jobs import _accepts_heartbeat
+    if heartbeat is not None and _accepts_heartbeat(fn):
+        return fn(args, heartbeat=heartbeat)
+    return fn(args)
+
+
+def run_proof_method(state, method: str, params: dict,
+                     heartbeat=None) -> dict:
     """Prove one request. This is the job-queue runner: everything here runs
     in a worker thread, and the returned dict is the JSON-RPC `result`
-    (JSON-serializable, journal-safe)."""
+    (JSON-serializable, journal-safe). `heartbeat` (optional zero-arg
+    callback) is the worker's stall-detection stamp, invoked between
+    prove phases."""
     if method == RPC_METHOD_STEP:
         spec = state.spec
         args = step_args_from_finality_update(
@@ -71,7 +87,7 @@ def run_proof_method(state, method: str, params: dict) -> dict:
             params["pubkeys"],
             bytes.fromhex(params["domain"].removeprefix("0x")),
             spec)
-        proof, instances = state.prove_step(args)
+        proof, instances = _prove_call(state.prove_step, args, heartbeat)
         return {
             "proof": "0x" + proof.hex(),
             "instances": [hex(v) for v in instances],
@@ -80,7 +96,8 @@ def run_proof_method(state, method: str, params: dict) -> dict:
     if method == RPC_METHOD_COMMITTEE:
         args = rotation_args_from_update(
             params["light_client_update"], state.spec)
-        proof, instances = state.prove_committee(args)
+        proof, instances = _prove_call(state.prove_committee, args,
+                                       heartbeat)
         # compressed layout: 12 accumulator limbs then app instances,
         # poseidon at [12] (reference: rpc.rs:106 `instances[0][12]`)
         pos_idx = 12 if getattr(state, "compress", False) else 0
@@ -101,6 +118,8 @@ _ERROR_KIND_CODES = {
     "AssertionError": (WITNESS_REJECTED, "witness rejected"),
     "KeyError": (INVALID_PARAMS, "missing param"),
     "TimeoutError": (JOB_FAILED, "job failed"),
+    "StalledWorker": (JOB_FAILED, "job failed"),
+    "ArtifactCorrupt": (JOB_FAILED, "result artifact corrupt"),
 }
 
 
@@ -121,11 +140,13 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet by default
         pass
 
-    def _reply(self, resp: dict, status: int = 200):
+    def _reply(self, resp: dict, status: int = 200, headers: dict = None):
         body = json.dumps(resp).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -173,6 +194,17 @@ class _Handler(BaseHTTPRequestHandler):
         id_ = req.get("id")
         try:
             resp = self._dispatch(req)
+        except ServiceOverloaded as exc:
+            # load shed (ISSUE 6): -32001 on the RPC envelope, 429 +
+            # Retry-After on the transport — well-behaved clients back
+            # off by retry_after_s instead of hammering a drowning box
+            resp = _error(SERVICE_OVERLOADED,
+                          f"service overloaded: {exc}", id_,
+                          data={"retry_after_s": exc.retry_after_s})
+            self._reply(resp, status=429,
+                        headers={"Retry-After":
+                                 str(max(1, int(exc.retry_after_s + 0.5)))})
+            return
         except AssertionError as exc:
             resp = _error(WITNESS_REJECTED, f"witness rejected: {exc}", id_)
         except KeyError as exc:
@@ -201,7 +233,11 @@ class _Handler(BaseHTTPRequestHandler):
             blocking = {RPC_METHOD_STEP_SUBMIT: RPC_METHOD_STEP,
                         RPC_METHOD_COMMITTEE_SUBMIT: RPC_METHOD_COMMITTEE}
             timeout = params.pop("timeout", None)
-            jid = self.jobs.submit(blocking[method], params, timeout=timeout)
+            # deadline propagation: the client's own deadline clamps the
+            # per-job timeout — no worker burns on an unread result
+            deadline_s = params.pop("deadline_s", None)
+            jid = self.jobs.submit(blocking[method], params, timeout=timeout,
+                                   deadline_s=deadline_s)
             st = self.jobs.status(jid)
             result = {"job_id": jid, "status": st["status"]}
         elif method == "getProofStatus":
@@ -237,13 +273,15 @@ class _Handler(BaseHTTPRequestHandler):
 
 def serve(state: ProverState, host: str = "127.0.0.1", port: int = 3000,
           background: bool = False, journal_dir: str | None = None,
-          job_timeout: float | None = None):
+          job_timeout: float | None = None, **queue_kw):
     """`journal_dir` defaults to the state's params_dir (when set) — pass
     explicitly to place the crash-safe job journal elsewhere; `job_timeout`
-    is the default per-job deadline for async submissions."""
+    is the default per-job deadline for async submissions. Extra
+    `queue_kw` (queue_depth, mem_watermark_mb, stall_timeout, ...) reach
+    the JobQueue's admission/supervision layer."""
     _Handler.state = state
     _Handler.jobs = ensure_jobs(state, journal_dir=journal_dir,
-                                default_timeout=job_timeout)
+                                default_timeout=job_timeout, **queue_kw)
     server = ThreadingHTTPServer((host, port), _Handler)
     if background:
         t = threading.Thread(target=server.serve_forever, daemon=True)

@@ -6,27 +6,41 @@ member in the response raises a typed `RpcError(code, message)` instead of
 a bare KeyError. The async job API (`submitProof_*` / `getProofStatus` /
 `getProofResult`) is exposed alongside the blocking reference methods,
 plus a `wait_for_proof` poll helper and `health`/`healthz` probes.
+
+ISSUE 6: the service now LOAD-SHEDS (`-32001 service overloaded` /
+HTTP 429 with `Retry-After`). Submits and polls honor the server's
+`retry_after_s` hint with capped jitter in ONE bounded retry loop
+(`overload_retries`, default 2); an exhausted loop surfaces the typed
+`RpcError` with `.retry_after` set so callers can schedule their own
+retry. `sleep`/`rng` are injectable (the BeaconClient pattern) so the
+backoff paths test deterministically.
 """
 
 from __future__ import annotations
 
 import http.client
 import json
+import random
 import time
 import urllib.error
 import urllib.request
 
 from .rpc import (RPC_METHOD_COMMITTEE, RPC_METHOD_COMMITTEE_SUBMIT,
-                  RPC_METHOD_STEP, RPC_METHOD_STEP_SUBMIT)
+                  RPC_METHOD_STEP, RPC_METHOD_STEP_SUBMIT,
+                  SERVICE_OVERLOADED)
 
 
 class RpcError(RuntimeError):
-    """A JSON-RPC error response (code + message, as sent by the server)."""
+    """A JSON-RPC error response (code + message, as sent by the server).
+    `retry_after` carries the server's backoff hint (seconds) on a
+    `-32001 service overloaded` shed, else None."""
 
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str,
+                 retry_after: float | None = None):
         super().__init__(f"rpc error {code}: {message}")
         self.code = code
         self.message = message
+        self.retry_after = retry_after
 
 
 def _is_conn_reset(exc: BaseException) -> bool:
@@ -39,11 +53,31 @@ def _is_conn_reset(exc: BaseException) -> bool:
 
 class ProverClient:
     def __init__(self, url: str, timeout: float = 3600.0,
-                 conn_retries: int = 1):
+                 conn_retries: int = 1, overload_retries: int = 2,
+                 retry_after_cap: float = 30.0,
+                 sleep=time.sleep, rng=random.random):
         self.url = url
         self.timeout = timeout
         self.conn_retries = conn_retries
+        self.overload_retries = overload_retries
+        self.retry_after_cap = retry_after_cap
+        self._sleep = sleep
+        self._rng = rng
         self._id = 0
+
+    def _raise_rpc_error(self, data: dict, headers=None):
+        err = (data or {}).get("error") or {}
+        retry_after = None
+        if err.get("code") == SERVICE_OVERLOADED:
+            retry_after = (err.get("data") or {}).get("retry_after_s")
+            if retry_after is None and headers is not None:
+                try:
+                    retry_after = float(headers.get("Retry-After"))
+                except (TypeError, ValueError):
+                    pass
+        raise RpcError(err.get("code", -32603),
+                       err.get("message", "unknown error"),
+                       retry_after=retry_after)
 
     def _call(self, method: str, params: dict, timeout: float | None = None):
         self._id += 1
@@ -59,16 +93,42 @@ class ProverClient:
                         req, timeout=timeout or self.timeout) as resp:
                     data = json.load(resp)
                 break
+            except urllib.error.HTTPError as exc:
+                # HTTP 429 load shed: the body still carries the JSON-RPC
+                # -32001 envelope; surface it typed, with the Retry-After
+                if exc.code == 429:
+                    try:
+                        data = json.load(exc)
+                    except ValueError:
+                        data = {}
+                    self._raise_rpc_error(data, headers=exc.headers)
+                raise
             except Exception as exc:
                 if _is_conn_reset(exc) and attempt < self.conn_retries:
                     attempt += 1
                     continue
                 raise
         if "error" in data:
-            err = data["error"] or {}
-            raise RpcError(err.get("code", -32603),
-                           err.get("message", "unknown error"))
+            self._raise_rpc_error(data)
         return data["result"]
+
+    def _call_shedding(self, method: str, params: dict,
+                       timeout: float | None = None):
+        """`_call` plus the ONE bounded overload-retry loop: a -32001/429
+        shed sleeps the server's retry_after_s (capped, with jitter so a
+        shed fleet doesn't re-stampede) up to `overload_retries` times,
+        then surfaces the typed RpcError (with .retry_after) to the
+        caller."""
+        for attempt in range(self.overload_retries + 1):
+            try:
+                return self._call(method, params, timeout=timeout)
+            except RpcError as exc:
+                if exc.code != SERVICE_OVERLOADED \
+                        or attempt >= self.overload_retries:
+                    raise
+                base = exc.retry_after if exc.retry_after is not None else 1.0
+                delay = min(self.retry_after_cap, base)
+                self._sleep(delay * (1.0 + 0.25 * self._rng()))
 
     def ping(self) -> str:
         return self._call("ping", {}, timeout=min(self.timeout, 30.0))
@@ -89,21 +149,27 @@ class ProverClient:
     # -- async job API -----------------------------------------------------
 
     def submit_sync_step(self, finality_update: dict, pubkeys: list,
-                         domain: str, job_timeout: float | None = None) -> str:
+                         domain: str, job_timeout: float | None = None,
+                         deadline_s: float | None = None) -> str:
         params = {"light_client_finality_update": finality_update,
                   "pubkeys": pubkeys, "domain": domain}
         if job_timeout is not None:
             params["timeout"] = job_timeout
-        return self._call(RPC_METHOD_STEP_SUBMIT, params,
-                          timeout=min(self.timeout, 60.0))["job_id"]
+        if deadline_s is not None:
+            params["deadline_s"] = deadline_s
+        return self._call_shedding(RPC_METHOD_STEP_SUBMIT, params,
+                                   timeout=min(self.timeout, 60.0))["job_id"]
 
     def submit_committee_update(self, update: dict,
-                                job_timeout: float | None = None) -> str:
+                                job_timeout: float | None = None,
+                                deadline_s: float | None = None) -> str:
         params = {"light_client_update": update}
         if job_timeout is not None:
             params["timeout"] = job_timeout
-        return self._call(RPC_METHOD_COMMITTEE_SUBMIT, params,
-                          timeout=min(self.timeout, 60.0))["job_id"]
+        if deadline_s is not None:
+            params["deadline_s"] = deadline_s
+        return self._call_shedding(RPC_METHOD_COMMITTEE_SUBMIT, params,
+                                   timeout=min(self.timeout, 60.0))["job_id"]
 
     def proof_status(self, job_id: str) -> dict:
         return self._call("getProofStatus", {"job_id": job_id},
@@ -123,13 +189,15 @@ class ProverClient:
         Raises RpcError on a failed job and TimeoutError past `timeout`."""
         deadline = None if timeout is None else time.time() + timeout
         while True:
-            st = self.proof_status(job_id)
+            # polls ride the same bounded overload-retry loop as submits
+            st = self._call_shedding("getProofStatus", {"job_id": job_id},
+                                     timeout=min(self.timeout, 30.0))
             if st["status"] in ("done", "failed", "cancelled"):
                 return self.proof_result(job_id)
             if deadline is not None and time.time() > deadline:
                 raise TimeoutError(f"job {job_id} still {st['status']} "
                                    f"after {timeout}s")
-            time.sleep(poll)
+            self._sleep(poll)
 
     def health(self) -> dict:
         return self._call("health", {}, timeout=min(self.timeout, 30.0))

@@ -80,18 +80,22 @@ class ProverState:
         return AggregationArgs(inner_vk=pk.vk, srs=self.srs[k],
                                inner_instances=[inst], proof=proof)
 
-    def _compressed(self, circuit, pk, k, agg_cls, agg_pk, args, bk=None):
+    def _compressed(self, circuit, pk, k, agg_cls, agg_pk, args, bk=None,
+                    heartbeat=None):
         from ..models import AggregationArgs, AggregationCircuit
         from ..plonk.transcript import KeccakTranscript, PoseidonTranscript
+        hb = heartbeat or (lambda: None)
         bk = bk if bk is not None else self.backend
         app_proof = circuit.prove(pk, self.srs[k], args, self.spec, bk,
                                   transcript=PoseidonTranscript())
+        hb()              # phase boundary: app snark done, aggregation next
         inst = circuit.get_instances(args, self.spec)
         agg_args = AggregationArgs(inner_vk=pk.vk, srs=self.srs[k],
                                    inner_instances=[inst], proof=app_proof)
         outer = agg_cls.prove(agg_pk, self.srs[self.k_agg], agg_args,
                               self.spec, bk,
                               transcript=KeccakTranscript())
+        hb()
         return outer, AggregationCircuit.get_instances(agg_args, self.spec)
 
     def _release_idle_ext_caches(self, *active_pks):
@@ -105,8 +109,13 @@ class ProverState:
             if pk is not None and all(pk is not a for a in active_pks):
                 pk.release_ext_cache()
 
-    def prove_step(self, args) -> tuple[bytes, list]:
+    def prove_step(self, args, heartbeat=None) -> tuple[bytes, list]:
+        """`heartbeat` (optional zero-arg callback, threaded in by the job
+        queue's worker) is stamped between prove phases so the supervisor
+        can tell a long legitimate prove from a hung worker."""
+        hb = heartbeat or (lambda: None)
         with self.semaphore:
+            hb()                     # phase: permit acquired, prove starts
             self._release_idle_ext_caches(self.step_pk,
                                           getattr(self, "step_agg_pk", None))
             if self.compress:
@@ -114,13 +123,14 @@ class ProverState:
                     lambda bk: self._compressed(StepCircuit, self.step_pk,
                                                 self.k_step, self.step_agg,
                                                 self.step_agg_pk, args,
-                                                bk=bk),
+                                                bk=bk, heartbeat=hb),
                     self.backend)
             proof = B.prove_with_fallback(
                 lambda bk: StepCircuit.prove(self.step_pk,
                                              self.srs[self.k_step],
                                              args, self.spec, bk),
                 self.backend)
+            hb()
         return proof, StepCircuit.get_instances(args, self.spec)
 
     def prove_step_batch(self, args_list: list) -> list:
@@ -141,8 +151,10 @@ class ProverState:
         with ThreadPoolExecutor(max_workers=max(1, self.concurrency)) as ex:
             return list(ex.map(self.prove_committee, args_list))
 
-    def prove_committee(self, args) -> tuple[bytes, list]:
+    def prove_committee(self, args, heartbeat=None) -> tuple[bytes, list]:
+        hb = heartbeat or (lambda: None)
         with self.semaphore:
+            hb()
             self._release_idle_ext_caches(
                 self.committee_pk, getattr(self, "committee_agg_pk", None))
             if self.compress:
@@ -152,11 +164,12 @@ class ProverState:
                                                 self.k_committee,
                                                 self.committee_agg,
                                                 self.committee_agg_pk, args,
-                                                bk=bk),
+                                                bk=bk, heartbeat=hb),
                     self.backend)
             proof = B.prove_with_fallback(
                 lambda bk: CommitteeUpdateCircuit.prove(
                     self.committee_pk, self.srs[self.k_committee], args,
                     self.spec, bk),
                 self.backend)
+            hb()
         return proof, CommitteeUpdateCircuit.get_instances(args, self.spec)

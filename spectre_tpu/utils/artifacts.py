@@ -1,0 +1,167 @@
+"""Integrity-checked durable artifact store (ISSUE 6, tentpole part 3).
+
+Large proof payloads used to live INSIDE the fsync'd job journal — every
+multi-hundred-KB proof re-written on each compaction, re-parsed on every
+replay, and served back with zero end-to-end verification. This module
+moves them to content-addressed files with the sha256 as the name, so:
+
+* the journal stays O(#jobs) — a terminal record carries a 64-char digest,
+  not the proof bytes;
+* every read re-hashes and compares: silent disk rot (bit flips, torn
+  writes that survived fsync lies) is DETECTED, the poisoned file is moved
+  to ``quarantine/`` (never served, never silently deleted — operators can
+  forensic it), and the caller gets a typed :class:`ArtifactCorrupt`;
+* writes are crash-atomic: tmp file + flush + fsync + ``os.replace`` +
+  directory fsync, the same discipline as the journal compaction sidecar.
+
+The store is also the home of the sidecar-checksum helpers the SRS loader
+uses (``<path>.sha256``): params files are multi-GB at production degrees
+and a corrupt SRS must be a clear typed startup failure, not a deep
+assertion blow-up three layers into keygen.
+
+Fault-injection sites: ``artifact.write`` / ``artifact.read`` (kinds
+``ioerror`` and the bytes-mangling ``corrupt``) — see utils/faults.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+
+from . import faults
+from .health import HEALTH
+
+RESULTS_DIR = "results"
+QUARANTINE_DIR = "quarantine"
+SIDECAR_SUFFIX = ".sha256"
+
+
+class ArtifactCorrupt(RuntimeError):
+    """An artifact's bytes do not match its recorded digest.
+
+    Raised instead of serving poisoned data; the service layer reports it
+    as a clear integrity failure (the result file was quarantined / the
+    SRS refused to load), never as a generic internal error."""
+
+    def __init__(self, path: str, expected: str, actual: str):
+        super().__init__(
+            f"artifact integrity failure: {path} hashes to "
+            f"{actual[:16]}…, journal/sidecar says {expected[:16]}…")
+        self.path = path
+        self.expected = expected
+        self.actual = actual
+
+
+def sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _fsync_dir(path: str):
+    try:
+        dfd = os.open(path or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass                       # not all filesystems allow dir fsync
+
+
+def _atomic_write(path: str, data: bytes):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+
+
+class ArtifactStore:
+    """Content-addressed blob store under ``<base_dir>/results/``.
+
+    ``write`` returns the sha256 hex digest (the journal records it);
+    ``read(digest)`` re-verifies and quarantines on mismatch. Thread-safe:
+    concurrent writers of the same content converge on the same file."""
+
+    def __init__(self, base_dir: str, health=HEALTH):
+        self.dir = os.path.join(base_dir, RESULTS_DIR)
+        self.quarantine_dir = os.path.join(self.dir, QUARANTINE_DIR)
+        os.makedirs(self.dir, exist_ok=True)
+        self.health = health
+        self._lock = threading.Lock()
+
+    def path_for(self, digest: str) -> str:
+        return os.path.join(self.dir, f"{digest}.bin")
+
+    def exists(self, digest: str) -> bool:
+        return os.path.exists(self.path_for(digest))
+
+    def write(self, data: bytes) -> str:
+        """Atomically persist `data`; returns its sha256 hex digest."""
+        faults.check("artifact.write")
+        digest = sha256_hex(data)
+        # corrupt-at-write: digest records the INTENDED bytes, the disk
+        # gets flipped ones — exactly the rot the read-side check catches
+        data = faults.mangle("artifact.write", data)
+        path = self.path_for(digest)
+        with self._lock:
+            if not os.path.exists(path):
+                _atomic_write(path, data)
+        return digest
+
+    def read(self, digest: str) -> bytes:
+        """Load + verify; a digest mismatch quarantines the file and
+        raises :class:`ArtifactCorrupt` instead of serving it."""
+        faults.check("artifact.read")
+        path = self.path_for(digest)
+        with open(path, "rb") as f:
+            data = f.read()
+        data = faults.mangle("artifact.read", data)
+        actual = sha256_hex(data)
+        if actual != digest:
+            self._quarantine(path)
+            raise ArtifactCorrupt(path, digest, actual)
+        return data
+
+    def _quarantine(self, path: str):
+        """Move a poisoned file aside (never served again, never silently
+        destroyed) and count it."""
+        with self._lock:
+            os.makedirs(self.quarantine_dir, exist_ok=True)
+            try:
+                os.replace(path, os.path.join(self.quarantine_dir,
+                                              os.path.basename(path)))
+            except OSError:
+                pass               # already moved by a racing reader
+        self.health.incr("artifacts_quarantined")
+
+
+# -- sidecar checksums (SRS / params files) --------------------------------
+
+def write_sidecar(path: str) -> str:
+    """Write ``<path>.sha256`` next to an existing file; returns the hex
+    digest. The sidecar itself is written atomically."""
+    with open(path, "rb") as f:
+        digest = sha256_hex(f.read())
+    _atomic_write(path + SIDECAR_SUFFIX, (digest + "\n").encode())
+    return digest
+
+
+def verify_sidecar(path: str, data: bytes | None = None):
+    """Verify `path` (or pre-read `data`) against ``<path>.sha256``.
+
+    A MISSING sidecar is not an error (pre-checksum params dirs stay
+    loadable); a mismatching one raises :class:`ArtifactCorrupt`."""
+    sidecar = path + SIDECAR_SUFFIX
+    if not os.path.exists(sidecar):
+        return
+    with open(sidecar) as f:
+        expected = f.read().strip()
+    if data is None:
+        with open(path, "rb") as f:
+            data = f.read()
+    actual = sha256_hex(data)
+    if actual != expected:
+        raise ArtifactCorrupt(path, expected, actual)

@@ -17,6 +17,9 @@ Injection sites threaded through the codebase:
     srs.load        plonk/srs.py            SRS file read / setup
     backend.prove   plonk/backend.py        prove_with_fallback entry
     journal.write   prover_service/jobs.py  each fsync'd journal append
+    journal.compact prover_service/jobs.py  staged-sidecar swap window
+    artifact.write  utils/artifacts.py      result-file atomic write
+    artifact.read   utils/artifacts.py      result-file read + verify
 
 Kinds and the exception they raise:
 
@@ -32,6 +35,11 @@ Kinds and the exception they raise:
                 deliberately NOT caught by ``except Exception`` recovery
                 paths, so journal-replay tests exercise a real mid-prove
                 death)
+    corrupt     no exception — DATA corruption: ``mangle(site, data)``
+                bit-flips one byte of the payload passing through the
+                site (silent disk rot / a torn DMA, the failure mode
+                end-to-end checksums exist for). ``check()`` ignores
+                ``corrupt`` entries; only ``mangle()`` consumes them.
 
 The registry is thread-safe and records every firing in ``fired`` so tests
 assert exact retry counts. Tests arm plans programmatically via ``arm()``/
@@ -47,7 +55,7 @@ import threading
 ENV_VAR = "SPECTRE_FAULT_PLAN"
 
 KINDS = ("raise", "oom", "compile", "http503", "http429", "timeout",
-         "connreset", "ioerror", "crash")
+         "connreset", "ioerror", "crash", "corrupt")
 
 
 class InjectedFault(Exception):
@@ -162,7 +170,8 @@ class FaultRegistry:
             if self._env_seen is not None or not self._plan:
                 self._sync_env_locked()
             for entry in self._plan:
-                if entry[0] == site and entry[2] > 0:
+                if entry[0] == site and entry[2] > 0 \
+                        and entry[1] != "corrupt":
                     entry[2] -= 1
                     self.fired.append((site, entry[1]))
                     exc = _make_exc(site, entry[1])
@@ -170,6 +179,27 @@ class FaultRegistry:
             else:
                 return
         raise exc
+
+    def mangle(self, site: str, data: bytes) -> bytes:
+        """Consume an armed ``corrupt`` entry for `site` by bit-flipping
+        one byte of `data` (silent corruption — no exception). Unarmed
+        sites return the payload untouched."""
+        with self._lock:
+            if self._env_seen is not None or not self._plan:
+                self._sync_env_locked()
+            for entry in self._plan:
+                if entry[0] == site and entry[2] > 0 \
+                        and entry[1] == "corrupt":
+                    entry[2] -= 1
+                    self.fired.append((site, "corrupt"))
+                    break
+            else:
+                return data
+        if not data:
+            return data
+        buf = bytearray(data)
+        buf[len(buf) // 2] ^= 0x01
+        return bytes(buf)
 
     def fired_count(self, site: str | None = None) -> int:
         with self._lock:
@@ -187,6 +217,7 @@ class FaultRegistry:
 # process-global registry: injection sites call faults.check("<site>")
 REGISTRY = FaultRegistry()
 check = REGISTRY.check
+mangle = REGISTRY.mangle
 arm = REGISTRY.arm
 clear = REGISTRY.clear
 install_plan = REGISTRY.install_plan

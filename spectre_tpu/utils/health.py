@@ -21,6 +21,7 @@ class ServiceHealth:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: dict[str, int] = {}
+        self._gauges: dict[str, tuple[int, float]] = {}  # name -> (n, sum)
         self._started = time.time()
 
     def incr(self, name: str, n: int = 1) -> int:
@@ -33,14 +34,32 @@ class ServiceHealth:
         with self._lock:
             return self._counters.get(name, 0)
 
+    def observe(self, name: str, value: float):
+        """Record a sample for a running-mean gauge (e.g. prove latency —
+        the admission controller derives retry_after_s from its mean)."""
+        with self._lock:
+            n, total = self._gauges.get(name, (0, 0.0))
+            self._gauges[name] = (n + 1, total + float(value))
+
+    def mean(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            n, total = self._gauges.get(name, (0, 0.0))
+            return total / n if n else default
+
     def snapshot(self) -> dict:
         with self._lock:
-            return {"uptime_s": round(time.time() - self._started, 3),
+            snap = {"uptime_s": round(time.time() - self._started, 3),
                     "counters": dict(sorted(self._counters.items()))}
+            if self._gauges:
+                snap["means"] = {k: round(total / n, 6)
+                                 for k, (n, total)
+                                 in sorted(self._gauges.items()) if n}
+            return snap
 
     def reset(self):
         with self._lock:
             self._counters.clear()
+            self._gauges.clear()
             self._started = time.time()
 
 

@@ -619,3 +619,358 @@ class TestSrsFaultSite:
         # disarmed: the retried load succeeds
         srs = SRS.load_or_setup(4, str(tmp_path))
         assert srs.k == 4
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6: admission control + backpressure
+# ---------------------------------------------------------------------------
+
+class TestAdmissionControl:
+    """Overload-safe submission: a full queue (or a breached host-memory
+    watermark) sheds NEW work with a typed ServiceOverloaded carrying a
+    retry_after_s hint priced off the observed mean prove latency."""
+
+    def _gated_runner(self):
+        gate = threading.Event()
+        started = threading.Event()
+
+        def runner(method, params):
+            started.set()
+            assert gate.wait(timeout=30), "test forgot to open the gate"
+            return _digest_runner(method, params)
+        return runner, gate, started
+
+    def test_queue_full_sheds_then_recovers(self, tmp_path):
+        from spectre_tpu.prover_service.jobs import (JobQueue,
+                                                     ServiceOverloaded)
+        runner, gate, started = self._gated_runner()
+        q = JobQueue(runner, concurrency=1, journal_dir=str(tmp_path),
+                     queue_depth=1)
+        shed0 = HEALTH.get("jobs_shed_queue")
+        a = q.submit("m", {"w": "a"})
+        assert started.wait(timeout=10)      # worker picked A up: running
+        for _ in range(100):                 # drain race: wait off "queued"
+            if q.status(a)["status"] == "running":
+                break
+            time.sleep(0.02)
+        b = q.submit("m", {"w": "b"})        # fills the 1-deep backlog
+        with pytest.raises(ServiceOverloaded) as exc:
+            q.submit("m", {"w": "c"})
+        assert exc.value.retry_after_s >= 1.0
+        assert HEALTH.get("jobs_shed_queue") == shed0 + 1
+        # ...but a DEDUP of already-admitted work is never shed
+        assert q.submit("m", {"w": "b"}) == b
+        gate.set()                           # drain
+        assert q.wait(a, timeout=10).status == "done"
+        assert q.wait(b, timeout=10).status == "done"
+        # the retried submission now admits and completes
+        c = q.submit("m", {"w": "c"})
+        assert q.wait(c, timeout=10).status == "done"
+        assert q.result(c).result == _digest_runner("m", {"w": "c"})
+        q.stop()
+
+    def test_memory_watermark_sheds(self, tmp_path):
+        from spectre_tpu.prover_service.jobs import (JobQueue,
+                                                     ServiceOverloaded,
+                                                     rss_mb)
+        if rss_mb() is None:
+            pytest.skip("no /proc/self/statm on this platform")
+        assert rss_mb() > 1.0                # a live CPython is >1MB
+        q = JobQueue(_digest_runner, concurrency=1,
+                     journal_dir=str(tmp_path), mem_watermark_mb=1.0)
+        shed0 = HEALTH.get("jobs_shed_memory")
+        with pytest.raises(ServiceOverloaded, match="memory watermark"):
+            q.submit("m", {"w": 1})
+        assert HEALTH.get("jobs_shed_memory") == shed0 + 1
+        q.stop()
+
+    def test_watermark_zero_disables(self, tmp_path):
+        from spectre_tpu.prover_service.jobs import JobQueue
+        q = JobQueue(_digest_runner, concurrency=1,
+                     journal_dir=str(tmp_path), mem_watermark_mb=0)
+        jid = q.submit("m", {"w": 2})
+        assert q.wait(jid, timeout=10).status == "done"
+        q.stop()
+
+    def test_retry_after_priced_by_observed_latency(self, tmp_path):
+        from spectre_tpu.prover_service.jobs import JobQueue
+        h = ServiceHealth()
+        h.observe("prove_latency_s", 10.0)
+        h.observe("prove_latency_s", 20.0)   # mean 15s
+        q = JobQueue(_digest_runner, concurrency=1,
+                     journal_dir=str(tmp_path), health=h)
+        assert q.retry_after_s() == 15.0     # empty backlog: one mean prove
+        q.stop()
+
+    def test_env_defaults(self, tmp_path, monkeypatch):
+        from spectre_tpu.prover_service import jobs as J
+        monkeypatch.setenv(J.QUEUE_DEPTH_ENV, "3")
+        monkeypatch.setenv(J.MEM_WATERMARK_ENV, "123.5")
+        monkeypatch.setenv(J.WORKER_STALL_ENV, "7.5")
+        q = J.JobQueue(_digest_runner, concurrency=1,
+                       journal_dir=str(tmp_path))
+        assert q.queue_depth == 3
+        assert q.mem_watermark_mb == 123.5
+        assert q.stall_timeout == 7.5
+        assert q.stats()["queue_depth"] == 3
+        q.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6: deadline propagation + worker supervision
+# ---------------------------------------------------------------------------
+
+class TestDeadlinePropagation:
+    def test_deadline_clamps_timeout(self, tmp_path):
+        from spectre_tpu.prover_service.jobs import JobQueue
+        q = JobQueue(_digest_runner, concurrency=1,
+                     journal_dir=str(tmp_path), default_timeout=100.0)
+        # client deadline below the server default wins...
+        a = q.submit("m", {"w": "d1"}, deadline_s=0.5)
+        assert q.result(a).timeout == 0.5
+        # ...a LOOSER client deadline never relaxes the server's cap
+        b = q.submit("m", {"w": "d2"}, timeout=0.25, deadline_s=50.0)
+        assert q.result(b).timeout == 0.25
+        q.stop()
+
+    def test_deadline_expires_running_job(self, tmp_path):
+        from spectre_tpu.prover_service.jobs import JobQueue
+        gate = threading.Event()
+
+        def runner(method, params):
+            gate.wait(timeout=30)
+            return _digest_runner(method, params)
+
+        q = JobQueue(runner, concurrency=1, journal_dir=str(tmp_path))
+        t0 = HEALTH.get("jobs_timed_out")
+        jid = q.submit("m", {"w": "slow"}, deadline_s=0.15)
+        job = q.wait(jid, timeout=10)
+        assert job.status == "failed"
+        assert job.error["kind"] == "TimeoutError"
+        assert HEALTH.get("jobs_timed_out") == t0 + 1
+        gate.set()
+        q.stop()
+
+
+class TestWorkerSupervision:
+    """A hung worker (wedged device call: heartbeat stops) is detected by
+    the supervisor, its job failed(stalled), and a replacement thread takes
+    the slot — other jobs keep completing. Deterministic + fast via the
+    injectable stall_timeout / sleep_interval knobs."""
+
+    def test_stalled_worker_replaced(self, tmp_path):
+        from spectre_tpu.prover_service.jobs import JobQueue
+        release = threading.Event()
+
+        def runner(method, params):
+            if params.get("hang"):
+                release.wait(timeout=30)     # no heartbeat: presumed hung
+                return {"proof": "late"}
+            return _digest_runner(method, params)
+
+        q = JobQueue(runner, concurrency=1, journal_dir=str(tmp_path),
+                     stall_timeout=0.3, sleep_interval=0.05)
+        r0 = HEALTH.get("workers_replaced")
+        hung = q.submit("m", {"hang": True})
+        job = q.wait(hung, timeout=10)
+        assert job.status == "failed"
+        assert job.error["kind"] == "StalledWorker"
+        assert HEALTH.get("workers_replaced") == r0 + 1
+        # the REPLACEMENT worker serves new jobs
+        ok = q.submit("m", {"w": "after-stall"})
+        assert q.wait(ok, timeout=10).status == "done"
+        # the disowned thread waking up must NOT resurrect the failed job
+        release.set()
+        time.sleep(0.2)
+        assert q.result(hung).status == "failed"
+        assert q.result(ok).result == _digest_runner("m",
+                                                     {"w": "after-stall"})
+        q.stop()
+
+    def test_heartbeat_keeps_slow_prove_alive(self, tmp_path):
+        from spectre_tpu.prover_service.jobs import JobQueue
+
+        def runner(method, params, heartbeat=None):
+            # a LEGITIMATE slow prove: total 0.6s >> stall_timeout, but
+            # the phase-boundary heartbeats keep the supervisor off it
+            for _ in range(6):
+                time.sleep(0.1)
+                heartbeat()
+            return _digest_runner(method, params)
+
+        q = JobQueue(runner, concurrency=1, journal_dir=str(tmp_path),
+                     stall_timeout=0.3, sleep_interval=0.05)
+        r0 = HEALTH.get("workers_replaced")
+        jid = q.submit("m", {"w": "slow-but-alive"})
+        assert q.wait(jid, timeout=10).status == "done"
+        assert HEALTH.get("workers_replaced") == r0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6: integrity-checked artifact store
+# ---------------------------------------------------------------------------
+
+class TestArtifactStore:
+    def _mk(self, tmp_path):
+        from spectre_tpu.utils.artifacts import ArtifactStore
+        return ArtifactStore(str(tmp_path))
+
+    def test_write_read_roundtrip_and_dedup(self, tmp_path):
+        import os
+        store = self._mk(tmp_path)
+        d = store.write(b"proof-bytes")
+        assert store.read(d) == b"proof-bytes"
+        assert os.path.exists(store.path_for(d))
+        assert store.write(b"proof-bytes") == d     # content-addressed
+
+    def test_bitflip_quarantined(self, tmp_path):
+        import os
+        from spectre_tpu.utils.artifacts import ArtifactCorrupt
+        store = self._mk(tmp_path)
+        d = store.write(b"proof-bytes")
+        path = store.path_for(d)
+        blob = bytearray(open(path, "rb").read())
+        blob[3] ^= 0x40
+        with open(path, "wb") as f:
+            f.write(bytes(blob))
+        q0 = HEALTH.get("artifacts_quarantined")
+        with pytest.raises(ArtifactCorrupt):
+            store.read(d)
+        assert HEALTH.get("artifacts_quarantined") == q0 + 1
+        assert not os.path.exists(path)             # moved, NOT deleted
+        assert os.path.exists(os.path.join(str(store.quarantine_dir),
+                                           os.path.basename(path)))
+        # the slot is re-writable after quarantine (re-prove path)
+        assert store.write(b"proof-bytes") == d
+        assert store.read(d) == b"proof-bytes"
+
+    def test_fault_corrupt_on_read(self, tmp_path):
+        from spectre_tpu.utils.artifacts import ArtifactCorrupt
+        store = self._mk(tmp_path)
+        d = store.write(b"payload")
+        faults.install_plan("artifact.read:corrupt:1")
+        with pytest.raises(ArtifactCorrupt):
+            store.read(d)
+        assert faults.fired_count("artifact.read") == 1
+
+    def test_fault_corrupt_on_write_detected_at_read(self, tmp_path):
+        from spectre_tpu.utils.artifacts import ArtifactCorrupt
+        store = self._mk(tmp_path)
+        faults.install_plan("artifact.write:corrupt:1")
+        d = store.write(b"payload")     # digest records the INTENDED bytes
+        with pytest.raises(ArtifactCorrupt):
+            store.read(d)
+
+    def test_fault_ioerror_on_write(self, tmp_path):
+        store = self._mk(tmp_path)
+        faults.install_plan("artifact.write:ioerror:1")
+        with pytest.raises(OSError):
+            store.write(b"payload")
+        assert store.write(b"payload")  # disarmed: succeeds
+
+
+class TestResultOffload:
+    """Job results live in the artifact store, the journal carries only
+    their sha256 — the journal is O(#jobs) and a flipped result bit is
+    caught (and quarantined) at replay instead of silently served."""
+
+    def _mk(self, tmp_path, runner=_digest_runner, **kw):
+        from spectre_tpu.prover_service.jobs import JobQueue
+        kw.setdefault("concurrency", 1)
+        return JobQueue(runner, journal_dir=str(tmp_path), **kw)
+
+    def test_result_offloaded_and_identical_after_restart(self, tmp_path):
+        from spectre_tpu.prover_service.jobs import JOURNAL_NAME
+        q = self._mk(tmp_path)
+        jid = q.submit("m", {"w": "off"})
+        job = q.wait(jid, timeout=10)
+        want = _digest_runner("m", {"w": "off"})
+        assert job.result == want
+        assert job.result_digest is not None
+        q.stop()
+        # the payload is NOT inlined in the journal
+        text = (tmp_path / JOURNAL_NAME).read_text()
+        assert want["proof"] not in text
+        assert job.result_digest in text
+        q2 = self._mk(tmp_path)
+        assert q2.result(jid).result == want        # re-verified hydrate
+        q2.stop()
+
+    def test_corrupt_result_quarantined_on_replay_then_reprovable(
+            self, tmp_path):
+        import os
+        q = self._mk(tmp_path)
+        jid = q.submit("m", {"w": "bits"})
+        job = q.wait(jid, timeout=10)
+        digest = job.result_digest
+        q.stop()
+        path = q.store.path_for(digest)
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0x01
+        with open(path, "wb") as f:
+            f.write(bytes(blob))
+        q0 = HEALTH.get("artifacts_quarantined")
+        q2 = self._mk(tmp_path)
+        replayed = q2.result(jid)
+        assert replayed.status == "failed"          # degraded, loudly
+        assert replayed.error["kind"] == "ArtifactCorrupt"
+        assert HEALTH.get("artifacts_quarantined") == q0 + 1
+        assert not os.path.exists(path)
+        # failed jobs do not pin the witness digest: resubmit RE-PROVES
+        jid2 = q2.submit("m", {"w": "bits"})
+        assert jid2 != jid
+        assert q2.wait(jid2, timeout=10).result == _digest_runner(
+            "m", {"w": "bits"})
+        q2.stop()
+
+    def test_journal_size_independent_of_payload(self, tmp_path,
+                                                 monkeypatch):
+        from spectre_tpu.prover_service.jobs import JOURNAL_NAME
+        big = "ab" * 65536                           # 128KB proof payload
+
+        def big_runner(method, params):
+            return {"proof": big, "w": params["w"]}
+
+        q = self._mk(tmp_path, runner=big_runner)
+        jids = [q.submit("m", {"w": i}) for i in range(4)]
+        for j in jids:
+            assert q.wait(j, timeout=10).status == "done"
+        q.stop()
+        monkeypatch.setenv("SPECTRE_JOURNAL_COMPACT_BYTES", "1")
+        q2 = self._mk(tmp_path, runner=big_runner)
+        size = (tmp_path / JOURNAL_NAME).stat().st_size
+        # O(#jobs): the compacted journal is smaller than ONE payload
+        assert size < len(big)
+        for i, j in enumerate(jids):
+            assert q2.result(j).result == {"proof": big, "w": i}
+        q2.stop()
+
+
+class TestSrsChecksum:
+    def test_sidecar_written_and_verified(self, tmp_path):
+        from spectre_tpu.plonk.srs import SRS
+        from spectre_tpu.utils.artifacts import SIDECAR_SUFFIX
+        srs = SRS.load_or_setup(4, str(tmp_path))
+        path = tmp_path / "kzg_bn254_4.srs"
+        assert (tmp_path / ("kzg_bn254_4.srs" + SIDECAR_SUFFIX)).exists()
+        assert SRS.read(str(path)).k == srs.k
+
+    def test_bitflipped_srs_refused(self, tmp_path):
+        from spectre_tpu.plonk.srs import SRS
+        from spectre_tpu.utils.artifacts import ArtifactCorrupt
+        SRS.load_or_setup(4, str(tmp_path))
+        path = tmp_path / "kzg_bn254_4.srs"
+        blob = bytearray(path.read_bytes())
+        blob[40] ^= 0x08                             # one flipped tau limb
+        path.write_bytes(bytes(blob))
+        with pytest.raises(ArtifactCorrupt):
+            SRS.read(str(path))
+        with pytest.raises(ArtifactCorrupt):
+            SRS.load_or_setup(4, str(tmp_path))      # load path refuses too
+
+    def test_missing_sidecar_stays_loadable(self, tmp_path):
+        from spectre_tpu.plonk.srs import SRS
+        from spectre_tpu.utils.artifacts import SIDECAR_SUFFIX
+        SRS.load_or_setup(4, str(tmp_path))
+        (tmp_path / ("kzg_bn254_4.srs" + SIDECAR_SUFFIX)).unlink()
+        assert SRS.read(str(tmp_path / "kzg_bn254_4.srs")).k == 4

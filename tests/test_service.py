@@ -536,6 +536,190 @@ class TestProverClient:
         assert len(calls) == 2
 
 
+class TestOverloadRPC:
+    """ISSUE 6: a shed submission surfaces as HTTP 429 + Retry-After on
+    the transport AND `-32001 service overloaded` (with data.retry_after_s)
+    in the JSON-RPC envelope; the typed client honors the hint."""
+
+    def _overloaded_server(self):
+        # queue_depth=0: every fresh submission sheds (deterministic)
+        from spectre_tpu.prover_service.rpc import serve
+        server = serve(_FakeState(TINY), port=0, background=True,
+                       queue_depth=0)
+        return server, server.server_address[1]
+
+    def test_429_retry_after_and_rpc_envelope(self):
+        import urllib.error
+        server, port = self._overloaded_server()
+        try:
+            body = json.dumps({
+                "jsonrpc": "2.0", "id": 1,
+                "method": "submitProof_SyncStepCompressed",
+                "params": _step_request_params(
+                    default_sync_step_args(TINY))}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/rpc", data=body,
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=60)
+            assert e.value.code == 429
+            assert int(e.value.headers["Retry-After"]) >= 1
+            err = json.load(e.value)["error"]
+            assert err["code"] == -32001
+            assert err["data"]["retry_after_s"] >= 1.0
+        finally:
+            server.shutdown()
+
+    def test_client_surfaces_retry_after(self):
+        from spectre_tpu.prover_service.rpc_client import (ProverClient,
+                                                           RpcError)
+        server, port = self._overloaded_server()
+        try:
+            sleeps = []
+            client = ProverClient(f"http://127.0.0.1:{port}/rpc",
+                                  timeout=60, overload_retries=1,
+                                  sleep=sleeps.append, rng=lambda: 0.0)
+            params = _step_request_params(default_sync_step_args(TINY))
+            with pytest.raises(RpcError) as e:
+                client.submit_sync_step(
+                    params["light_client_finality_update"],
+                    params["pubkeys"], params["domain"])
+            assert e.value.code == -32001
+            assert e.value.retry_after is not None
+            # the ONE bounded retry slept the server's hint before giving up
+            assert len(sleeps) == 1
+            assert sleeps[0] == pytest.approx(e.value.retry_after)
+        finally:
+            server.shutdown()
+
+    def test_client_shedding_retry_then_success(self, monkeypatch):
+        from spectre_tpu.prover_service.rpc import SERVICE_OVERLOADED
+        from spectre_tpu.prover_service.rpc_client import (ProverClient,
+                                                           RpcError)
+        sleeps = []
+        client = ProverClient("http://127.0.0.1:1/rpc", overload_retries=2,
+                              retry_after_cap=30.0, sleep=sleeps.append,
+                              rng=lambda: 0.0)
+        calls = {"n": 0}
+
+        def fake_call(method, params, timeout=None):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RpcError(SERVICE_OVERLOADED, "service overloaded",
+                               retry_after=2.5)
+            return {"job_id": "j1"}
+
+        monkeypatch.setattr(client, "_call", fake_call)
+        assert client._call_shedding("m", {}) == {"job_id": "j1"}
+        assert calls["n"] == 3
+        assert sleeps == [2.5, 2.5]        # server hint honored, rng=0
+        # an oversized hint is CAPPED (a shed must not park clients)
+        sleeps.clear()
+        calls["n"] = 0
+
+        def fake_call_big(method, params, timeout=None):
+            calls["n"] += 1
+            if calls["n"] < 2:
+                raise RpcError(SERVICE_OVERLOADED, "service overloaded",
+                               retry_after=900.0)
+            return {"job_id": "j2"}
+
+        monkeypatch.setattr(client, "_call", fake_call_big)
+        assert client._call_shedding("m", {}) == {"job_id": "j2"}
+        assert sleeps == [30.0]
+
+    def test_job_not_done_moved_to_32002(self):
+        from spectre_tpu.prover_service.rpc import serve
+        server = serve(_FakeState(TINY, delay=0.5), port=0, background=True)
+        port = server.server_address[1]
+        try:
+            sub = _rpc_post(port, {
+                "jsonrpc": "2.0", "id": 1,
+                "method": "submitProof_SyncStepCompressed",
+                "params": _step_request_params(
+                    default_sync_step_args(TINY))}, timeout=60)["result"]
+            err = _rpc_post(port, {"jsonrpc": "2.0", "id": 2,
+                                   "method": "getProofResult",
+                                   "params": {"job_id": sub["job_id"]}},
+                            timeout=60)["error"]
+            # -32001 now means "service overloaded"; pending moved here
+            assert err["code"] == -32002
+        finally:
+            server.shutdown()
+
+    def test_deadline_s_threads_through_rpc(self):
+        from spectre_tpu.prover_service.rpc import serve
+        server = serve(_FakeState(TINY, delay=1.0), port=0, background=True)
+        port = server.server_address[1]
+        try:
+            params = _step_request_params(default_sync_step_args(TINY))
+            params["deadline_s"] = 0.05
+            jid = _rpc_post(port, {
+                "jsonrpc": "2.0", "id": 1,
+                "method": "submitProof_SyncStepCompressed",
+                "params": params}, timeout=60)["result"]["job_id"]
+            import time
+            for _ in range(200):
+                st = _rpc_post(port, {"jsonrpc": "2.0", "id": 2,
+                                      "method": "getProofStatus",
+                                      "params": {"job_id": jid}},
+                               timeout=60)["result"]
+                if st["status"] in ("done", "failed", "cancelled"):
+                    break
+                time.sleep(0.05)
+            assert st["status"] == "failed"   # clamped by the client deadline
+        finally:
+            server.shutdown()
+
+
+class TestCancelRace:
+    """ISSUE 6 satellite: cancelProof racing completion must NOT resurrect
+    a terminal job or delete its stored artifact."""
+
+    def test_cancel_after_done_is_noop(self, tmp_path):
+        import os
+        from spectre_tpu.prover_service.jobs import JobQueue
+
+        def runner(method, params):
+            return {"proof": "0xfeed", "w": params["w"]}
+
+        q = JobQueue(runner, concurrency=1, journal_dir=str(tmp_path))
+        jid = q.submit("m", {"w": 1})
+        job = q.wait(jid, timeout=10)
+        assert job.status == "done"
+        apath = q.store.path_for(job.result_digest)
+        assert os.path.exists(apath)
+        assert q.cancel(jid) is False        # terminal: cancel refused
+        assert q.status(jid)["status"] == "done"
+        assert q.result(jid).result == {"proof": "0xfeed", "w": 1}
+        assert os.path.exists(apath)         # artifact untouched
+        # restart still serves the result (journal unpolluted by the race)
+        q.stop()
+        q2 = JobQueue(runner, concurrency=1, journal_dir=str(tmp_path))
+        assert q2.result(jid).result == {"proof": "0xfeed", "w": 1}
+        q2.stop()
+
+    def test_cancel_mid_run_still_cancels(self, tmp_path):
+        from spectre_tpu.prover_service.jobs import JobQueue
+        started = threading.Event()
+        gate = threading.Event()
+
+        def runner(method, params):
+            started.set()
+            gate.wait(timeout=30)
+            return {"proof": "0xdead"}
+
+        q = JobQueue(runner, concurrency=1, journal_dir=str(tmp_path))
+        jid = q.submit("m", {"w": 2})
+        assert started.wait(timeout=10)
+        assert q.cancel(jid) is True
+        gate.set()
+        job = q.wait(jid, timeout=10)
+        assert job.status == "cancelled"
+        assert job.result is None            # late result discarded
+        q.stop()
+
+
 class TestCLI:
     def test_parser(self):
         from spectre_tpu.prover_service.cli import main
