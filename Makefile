@@ -15,10 +15,12 @@ test: native lint test-faults bench-fast
 # fallback byte-equality, job-journal crash replay, MSM table-budget
 # degrade, admission-control shed/recover, stalled-worker replacement
 # (injectable clock keeps it seconds-scale), artifact-store quarantine,
-# SRS checksum refusal, overload RPC contract (429/-32001/Retry-After).
-# Also part of the full pytest ladder above.
+# SRS checksum refusal, overload RPC contract (429/-32001/Retry-After),
+# and the observability tier (PR 7): /metrics exposition parity,
+# getTrace span trees, peak-RSS attribution, broken-metrics-sink
+# tolerance. Also part of the full pytest ladder above.
 test-faults: native
-	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py tests/test_service.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py tests/test_service.py tests/test_observability.py -q
 
 test-slow: native
 	RUN_SLOW=1 python -m pytest tests/ -q

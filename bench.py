@@ -162,23 +162,34 @@ def device_phase(out_path: str):
     else:
         impls = [("soa", run_soa), ("aos", run_aos)]
 
+    # span-traced phases (ISSUE 7): bench JSON carries the SAME
+    # phase_seconds schema production traces expose via getTrace, and
+    # running the gated floors with tracing active doubles as the
+    # instrumentation-overhead gate
+    from spectre_tpu.observability import tracing
+    from spectre_tpu.utils.profiling import phase
+
     mismatch = None
     infra_fail = None
     for impl_name, run in impls:
         try:
-            res = run()  # compile + first run (+ fixed-base table build)
-            if not check(res):
-                mismatch = f"{impl_name}: result mismatch"
-                break      # a wrong result is a correctness regression —
+            with tracing.trace(f"bench-msm-{impl_name}") as tr:
+                with phase("bench/warmup_compile"):
+                    # compile + first run (+ fixed-base table build)
+                    res = run()
+                if not check(res):
+                    mismatch = f"{impl_name}: result mismatch"
+                    break  # a wrong result is a correctness regression —
                            # do NOT mask it behind a working fallback impl
-            dt = float("inf")
-            for _ in range(3):
-                t0 = time.time()
-                res = run()
-                dt = min(dt, time.time() - t0)
-            if not check(res):
-                mismatch = f"{impl_name}: result mismatch"
-                break
+                dt = float("inf")
+                for _ in range(3):
+                    with phase("bench/run"):
+                        t0 = time.time()
+                        res = run()
+                        dt = min(dt, time.time() - t0)
+                if not check(res):
+                    mismatch = f"{impl_name}: result mismatch"
+                    break
         except Exception as exc:  # Mosaic/lowering failures -> next impl
             infra_fail = f"{impl_name}: {type(exc).__name__}: {exc}"
             print(f"# bench impl {impl_name} failed: {infra_fail}",
@@ -190,6 +201,7 @@ def device_phase(out_path: str):
             json.dump({"points_per_s": n / dt, "impl": impl_name,
                        "msm_mode": mode if impl_name.startswith("aos")
                        else "vanilla",
+                       "phase_seconds": tracing.phase_seconds(tr),
                        "backend": jax.default_backend()}, f)
         return
     if mismatch:
@@ -261,48 +273,60 @@ def ntt_device_phase(out_path: str):
         return np.asarray(NTT.coset_lde_std(stack_d, omega_ext, g,
                                             mode=mode))
 
-    # compile + correctness gate: the batched fused kernel must be
-    # BYTE-IDENTICAL to the per-column jitted loop (exact arithmetic)
-    want = np.stack([np.asarray(one_col_jit(stack_d[i]))
-                     for i in range(batch)])
-    got = run_batched()
-    if not np.array_equal(want, got):
+    # span-traced phases (ISSUE 7): same schema as the MSM child / getTrace
+    from spectre_tpu.observability import tracing
+    from spectre_tpu.utils.profiling import phase
+
+    with tracing.trace(f"bench-ntt-{mode}") as tr:
+        # compile + correctness gate: the batched fused kernel must be
+        # BYTE-IDENTICAL to the per-column jitted loop (exact arithmetic)
+        with phase("bench/byte_check"):
+            want = np.stack([np.asarray(one_col_jit(stack_d[i]))
+                             for i in range(batch)])
+            got = run_batched()
+        if not np.array_equal(want, got):
+            with open(out_path, "w") as f:
+                json.dump({"error": f"ntt batched/{mode} result mismatch vs "
+                           f"per-column loop",
+                           "backend": jax.default_backend()}, f)
+            return
+
+        # the eager pre-PR loop is ~60x slower per column on this box —
+        # time a small sample once and scale (it IS the thing being
+        # replaced; burning the full batch x3 would dominate bench
+        # wall-clock)
+        base_cols = min(2, batch)
+        with phase("bench/eager_baseline"):
+            sample = np.asarray(one_col_prepr(stack_d[0]))  # warm caches
+            assert np.array_equal(sample, want[0]), \
+                "pre-PR loop result mismatch"
+            t0 = time.time()
+            for i in range(base_cols):
+                np.asarray(one_col_prepr(stack_d[i]))
+            base_dt = (time.time() - t0) / base_cols * batch
+
+        jl_dt = float("inf")
+        for _ in range(3):
+            with phase("bench/jitted_loop"):
+                t0 = time.time()
+                for i in range(batch):
+                    np.asarray(one_col_jit(stack_d[i]))
+                jl_dt = min(jl_dt, time.time() - t0)
+
+        dt = float("inf")
+        for _ in range(3):
+            with phase("bench/run"):
+                t0 = time.time()
+                run_batched()
+                dt = min(dt, time.time() - t0)
+
         with open(out_path, "w") as f:
-            json.dump({"error": f"ntt batched/{mode} result mismatch vs "
-                       f"per-column loop",
+            json.dump({"polys_per_s": batch / dt,
+                       "baseline_polys_per_s": batch / base_dt,
+                       "jitted_loop_polys_per_s": batch / jl_dt,
+                       "ntt_mode": mode, "impl": "batched",
+                       "phase_seconds": tracing.phase_seconds(tr),
                        "backend": jax.default_backend()}, f)
-        return
-
-    # the eager pre-PR loop is ~60x slower per column on this box — time a
-    # small sample once and scale (it IS the thing being replaced; burning
-    # the full batch x3 would dominate bench wall-clock)
-    base_cols = min(2, batch)
-    sample = np.asarray(one_col_prepr(stack_d[0]))   # warm compile caches
-    assert np.array_equal(sample, want[0]), "pre-PR loop result mismatch"
-    t0 = time.time()
-    for i in range(base_cols):
-        np.asarray(one_col_prepr(stack_d[i]))
-    base_dt = (time.time() - t0) / base_cols * batch
-
-    jl_dt = float("inf")
-    for _ in range(3):
-        t0 = time.time()
-        for i in range(batch):
-            np.asarray(one_col_jit(stack_d[i]))
-        jl_dt = min(jl_dt, time.time() - t0)
-
-    dt = float("inf")
-    for _ in range(3):
-        t0 = time.time()
-        run_batched()
-        dt = min(dt, time.time() - t0)
-
-    with open(out_path, "w") as f:
-        json.dump({"polys_per_s": batch / dt,
-                   "baseline_polys_per_s": batch / base_dt,
-                   "jitted_loop_polys_per_s": batch / jl_dt,
-                   "ntt_mode": mode, "impl": "batched",
-                   "backend": jax.default_backend()}, f)
 
 
 def _run_child(force_cpu: bool, expect: str, timeout: float,
@@ -439,6 +463,10 @@ def bench_msm(fast: bool) -> bool:
         "impl": result.get("impl"),
         "fallback": fallback,
     }
+    if result.get("phase_seconds"):
+        # per-phase breakdown from the child's span trace (ISSUE 7) —
+        # the same schema getTrace/phase_seconds exposes in production
+        record["phase_seconds"] = result["phase_seconds"]
     return _emit(record, fast, f"bn254_msm_2^{logn}_cpu_points_per_s",
                  "points/s")
 
@@ -493,6 +521,8 @@ def bench_ntt(fast: bool) -> bool:
         # decomposition: how much of vs_baseline is batching+fusion vs
         # plain dispatch amortization (BASELINE.md records both)
         record["vs_jitted_loop"] = round(value / jl, 3)
+    if result.get("phase_seconds"):
+        record["phase_seconds"] = result["phase_seconds"]
     return _emit(record, fast, f"bn254_ntt_2^{logn}_cpu_polys_per_s",
                  "polys/s")
 

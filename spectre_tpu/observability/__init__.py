@@ -1,0 +1,24 @@
+"""spectre_tpu.observability — the telemetry spine of the prover service.
+
+Four pieces, one principle (bridge, don't duplicate):
+
+* :mod:`.metrics` — counters/gauges/fixed-bucket histograms; the
+  prove-latency and per-phase histograms ServiceHealth's running means
+  cannot express.
+* :mod:`.prom` — Prometheus text exposition (0.0.4) over
+  `HEALTH.snapshot()`, queue stats, breaker states, table-LRU stats and
+  the registered histograms; served as `GET /metrics` by
+  prover_service/rpc.py.
+* :mod:`.tracing` — per-job span trees (trace id = job id) fed by
+  `utils/profiling.phase`; Chrome trace-event export via the `getTrace`
+  RPC and the SPECTRE_TRACE_DIR file sink.
+* :mod:`.rss` — per-job peak-RSS attribution from /proc/self/statm.
+
+Import order matters downstream: utils/profiling.py imports
+`.metrics`/`.tracing` (both stdlib-only), so nothing here may import
+the service layer or jax at module scope.
+"""
+
+from . import metrics, prom, rss, tracing
+
+__all__ = ["metrics", "prom", "rss", "tracing"]

@@ -301,6 +301,11 @@ class _TableLRU:
         self.hits = 0
         self.builds = 0
         self.evictions = 0
+        # thrash visibility (exported via GET /metrics): a build whose
+        # key was previously evicted is a RECOMPUTE — budget too small
+        # for the working set
+        self.recomputes = 0
+        self._evicted_keys: set = set()
 
     @staticmethod
     def _entry_bytes(table) -> int:
@@ -319,6 +324,9 @@ class _TableLRU:
     def put(self, key, base, table):
         nbytes = self._entry_bytes(table)
         self.builds += 1
+        if key in self._evicted_keys:
+            self.recomputes += 1
+            self._evicted_keys.discard(key)
         if nbytes > self.budget:
             import sys
             print(f"[lru] {self.label} ({nbytes >> 20} MB) exceeds "
@@ -330,9 +338,19 @@ class _TableLRU:
             _k, (_ref, old) = self._d.popitem(last=False)
             self._bytes -= self._entry_bytes(old)
             self.evictions += 1
+            self._evicted_keys.add(_k)
         self._d[key] = (base, table)
         self._bytes += nbytes
         return table
+
+    def stats(self) -> dict:
+        """Counter/occupancy snapshot for the Prometheus exporter
+        (observability/prom.py reads this via `lru_stats()`)."""
+        return {"hits": self.hits, "builds": self.builds,
+                "evictions": self.evictions,
+                "recomputes": self.recomputes,
+                "bytes": self._bytes, "budget_bytes": self.budget,
+                "entries": len(self._d)}
 
 
 def _table_budget_bytes() -> int:
@@ -348,6 +366,11 @@ def _table_budget_bytes() -> int:
 
 
 _TABLES = _TableLRU(_table_budget_bytes())
+
+
+def lru_stats() -> dict:
+    """Fixed-base table cache stats for GET /metrics."""
+    return _TABLES.stats()
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2))

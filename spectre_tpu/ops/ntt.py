@@ -99,6 +99,11 @@ _TABLES = _TableLRU(_table_budget_bytes(), label="ntt twiddle/coset table",
                     budget_var="SPECTRE_NTT_TABLE_MB")
 
 
+def lru_stats() -> dict:
+    """Twiddle/coset table cache stats for GET /metrics."""
+    return _TABLES.stats()
+
+
 @functools.cache
 def _bitrev(logn: int) -> np.ndarray:
     n = 1 << logn

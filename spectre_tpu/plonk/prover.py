@@ -190,77 +190,83 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
     gamma = tr.challenge()
 
     # --- 3. permutation grand products (chunk-linked) ---
-    col_keys = perm_column_keys(cfg)
-    omega_pows = bk.powers(dom.omega, n)
+    with phase("prove/grand_products"):
+        col_keys = perm_column_keys(cfg)
+        omega_pows = bk.powers(dom.omega, n)
 
-    def col_values(key):
-        kind, j = key
-        if kind == "adv":
-            return adv_vals[j]
-        if kind == "ladv":
-            return ladv_vals[j]
-        if kind == "fix":
-            return pk.fixed_values[j]
-        if kind == "shw":
-            return shw_vals[j]
-        if kind == "inst":
-            return inst_vals[j]
-        raise KeyError(key)
+        def col_values(key):
+            kind, j = key
+            if kind == "adv":
+                return adv_vals[j]
+            if kind == "ladv":
+                return ladv_vals[j]
+            if kind == "fix":
+                return pk.fixed_values[j]
+            if kind == "shw":
+                return shw_vals[j]
+            if kind == "inst":
+                return inst_vals[j]
+            raise KeyError(key)
 
-    prev_end = 1
-    nch = cfg.num_perm_chunks
-    gp_items = []    # pz + lz columns, committed in one batched call
-    for ch in range(nch):
-        cols = list(enumerate(col_keys))[ch * PERM_CHUNK:(ch + 1) * PERM_CHUNK]
-        num = B.to_arr([1] * n)
-        den = B.to_arr([1] * n)
-        for gidx, key in cols:
-            v_arr = B.to_arr(col_values(key))
-            dj = pow(DELTA, gidx, R)
-            id_term = bk.add_scalar(
-                bk.add(v_arr, bk.scale(omega_pows, beta * dj % R)), gamma)
-            sig_term = bk.add_scalar(
-                bk.add(v_arr, bk.scale(B.to_arr(pk.sigma_values[gidx]), beta)),
-                gamma)
-            num = bk.mul(num, id_term)
-            den = bk.mul(den, sig_term)
-        ratio = bk.mul(num, bk.inv(den))
-        # deactivate blinding rows
-        ratio_ints = B.arr_to_ints(ratio)
-        for i in range(u, n):
-            ratio_ints[i] = 1
-        prefix = bk.prefix_prod(B.to_arr(ratio_ints))
-        prefix_ints = B.arr_to_ints(prefix)
-        z = [prev_end] + [prev_end * p % R for p in prefix_ints[:-1]]
-        prev_end = prev_end * prefix_ints[u - 1] % R if u >= 1 else prev_end
-        # Blind the tail: every constraint touching z is inactive on rows
-        # u+1..n-1 (act excludes them, llast hits row u, ROT_LAST reads row u),
-        # but z is opened at x and omega*x — deterministic tail rows would leak
-        # witness information halo2 hides. Randomize them.
-        for i in range(u + 1, n):
-            z[i] = rand()
-        gp_items.append((("pz", ch), z))
-    assert prev_end == 1, "permutation product != 1 (copy constraints unsatisfiable)"
+        prev_end = 1
+        nch = cfg.num_perm_chunks
+        gp_items = []    # pz + lz columns, committed in one batched call
+        for ch in range(nch):
+            cols = list(enumerate(col_keys))[ch * PERM_CHUNK:
+                                             (ch + 1) * PERM_CHUNK]
+            num = B.to_arr([1] * n)
+            den = B.to_arr([1] * n)
+            for gidx, key in cols:
+                v_arr = B.to_arr(col_values(key))
+                dj = pow(DELTA, gidx, R)
+                id_term = bk.add_scalar(
+                    bk.add(v_arr, bk.scale(omega_pows, beta * dj % R)), gamma)
+                sig_term = bk.add_scalar(
+                    bk.add(v_arr, bk.scale(B.to_arr(pk.sigma_values[gidx]),
+                                           beta)),
+                    gamma)
+                num = bk.mul(num, id_term)
+                den = bk.mul(den, sig_term)
+            ratio = bk.mul(num, bk.inv(den))
+            # deactivate blinding rows
+            ratio_ints = B.arr_to_ints(ratio)
+            for i in range(u, n):
+                ratio_ints[i] = 1
+            prefix = bk.prefix_prod(B.to_arr(ratio_ints))
+            prefix_ints = B.arr_to_ints(prefix)
+            z = [prev_end] + [prev_end * p % R for p in prefix_ints[:-1]]
+            prev_end = prev_end * prefix_ints[u - 1] % R if u >= 1 \
+                else prev_end
+            # Blind the tail: every constraint touching z is inactive on rows
+            # u+1..n-1 (act excludes them, llast hits row u, ROT_LAST reads
+            # row u), but z is opened at x and omega*x — deterministic tail
+            # rows would leak witness information halo2 hides. Randomize them.
+            for i in range(u + 1, n):
+                z[i] = rand()
+            gp_items.append((("pz", ch), z))
+        assert prev_end == 1, \
+            "permutation product != 1 (copy constraints unsatisfiable)"
 
-    # --- 4. lookup grand products ---
-    for j in range(cfg.num_lookup_advice):
-        z = lookup_grand_product(
-            bk, n, u, values[("ladv", j)], values[("pA", j)],
-            values[("pT", j)], pk.table_values[j], beta, gamma)
-        for i in range(u + 1, n):        # blind tail rows (see pz above)
-            z[i] = rand()
-        gp_items.append((("lz", j), z))
-    # no challenge between pz and lz commits: one batched call
-    commit_cols_batched(gp_items)
+        # --- 4. lookup grand products ---
+        for j in range(cfg.num_lookup_advice):
+            z = lookup_grand_product(
+                bk, n, u, values[("ladv", j)], values[("pA", j)],
+                values[("pT", j)], pk.table_values[j], beta, gamma)
+            for i in range(u + 1, n):        # blind tail rows (see pz above)
+                z[i] = rand()
+            gp_items.append((("lz", j), z))
+        # no challenge between pz and lz commits: one batched call
+        commit_cols_batched(gp_items)
 
     y = tr.challenge()
 
     # instance polys (public-input binding in the identity) — both quotient
     # paths and nothing else create them, so hoist before the dispatch
     # (one batched iNTT over the instance-column stack)
-    for j, c in enumerate(dom.lagrange_to_coeff_many(
-            [B.to_arr(v) for v in inst_vals], bk)):
-        polys[("inst", j)] = c
+    with phase("prove/instance_polys"):
+        for j, c in enumerate(dom.lagrange_to_coeff_many(
+                [B.to_arr(v) for v in inst_vals], bk)):
+            polys[("inst", j)] = c
 
     def poly_for(key):
         kind, j = key
@@ -301,8 +307,9 @@ def prove(pk: ProvingKey, srs: SRS, assignment: Assignment,
             chunk = np.vstack([chunk, np.zeros((n - chunk.shape[0], 4), np.uint64)])
         polys[("h", i)] = chunk
         h_chunks.append(chunk)
-    for pt in kzg.commit_many(srs, h_chunks, bk):
-        tr.write_point(pt)
+    with phase("prove/commit_h"):
+        for pt in kzg.commit_many(srs, h_chunks, bk):
+            tr.write_point(pt)
 
     x = tr.challenge()
 

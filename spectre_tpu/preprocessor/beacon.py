@@ -87,12 +87,18 @@ import weakref
 _CLIENTS: "weakref.WeakSet" = weakref.WeakSet()
 
 
+# numeric codes for the Prometheus exporter (a gauge can't carry a
+# string; alerting rules compare against these)
+BREAKER_STATE_CODES = {"closed": 0, "half-open": 1, "open": 2}
+
+
 def breaker_snapshot() -> list[dict]:
     """Breaker state of every live BeaconClient, for /healthz readiness
     (ROADMAP PR-3 follow-up): an OPEN breaker means the upstream beacon is
     considered down and the service cannot make proving progress that
     needs fresh chain data — the readiness probe turns 503."""
     return [{"base_url": c.base_url, "state": c.breaker_state,
+             "state_code": BREAKER_STATE_CODES.get(c.breaker_state, -1),
              "consecutive_failures": c._consecutive_failures}
             for c in list(_CLIENTS)]
 

@@ -69,6 +69,12 @@ def main(argv=None):
                    help="supervisor stall threshold: a worker whose "
                    "heartbeat is older than this is replaced and its job "
                    "failed (default: $SPECTRE_WORKER_STALL_S or 600)")
+    r.add_argument("--trace-dir", default=None,
+                   help="write each completed job's span tree as Chrome "
+                   "trace-event JSON (<job_id>.trace.json) under this "
+                   "directory (default: $SPECTRE_TRACE_DIR; unset "
+                   "disables the file sink — getTrace still serves the "
+                   "in-memory ring)")
 
     u = sub.add_parser("utils", help="deployment utilities")
     u.add_argument("util", choices=["committee-poseidon"])
@@ -94,6 +100,9 @@ def main(argv=None):
               f"(async jobs journaled under "
               f"{args.params_dir or 'params_dir unset: in-memory only'})",
               flush=True)
+        if args.trace_dir is not None:
+            from ..observability.tracing import TRACE_DIR_ENV
+            os.environ[TRACE_DIR_ENV] = args.trace_dir
         queue_kw = {}
         if args.queue_depth is not None:
             queue_kw["queue_depth"] = args.queue_depth

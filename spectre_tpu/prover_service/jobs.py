@@ -30,11 +30,14 @@ Overload + integrity layer (ISSUE 6):
 
 * **Admission control** — the queue is bounded (`SPECTRE_JOB_QUEUE_DEPTH`,
   default 64): a full backlog rejects new submissions with a typed
-  :class:`ServiceOverloaded` carrying `retry_after_s` (derived from the
-  observed mean prove latency on ServiceHealth) instead of buffering
-  unboundedly. A host-memory watermark (`SPECTRE_MEM_WATERMARK_MB`,
-  psutil-free `/proc/self/statm`; graceful no-op off-Linux) sheds NEW work
-  before the box OOMs. Counters: `jobs_shed_queue` / `jobs_shed_memory`.
+  :class:`ServiceOverloaded` carrying `retry_after_s` (priced at the p90
+  of the queue-local prove-latency histogram, ISSUE 7; ServiceHealth
+  mean as the cold-start fallback) instead of buffering unboundedly. A
+  host-memory watermark (`SPECTRE_MEM_WATERMARK_MB`, psutil-free
+  `/proc/self/statm`; graceful no-op off-Linux) sheds NEW work before
+  the box OOMs. Counters: `jobs_shed_queue` / `jobs_shed_memory`; a
+  memory shed journals a `shed_memory` record attributing the per-job
+  `peak_rss_mb` of every running job (replay-inert: no job_id).
 * **Deadline propagation** — a client-supplied `deadline_s` clamps the
   per-job timeout at submit time.
 * **Worker supervision** — workers stamp a monotonic heartbeat between
@@ -68,6 +71,11 @@ import queue
 import threading
 import time
 
+from ..observability import metrics as obs_metrics
+from ..observability import tracing as obs_tracing
+from ..observability.rss import SAMPLER as RSS_SAMPLER
+from ..observability.rss import rss_mb  # noqa: F401  (re-export: the
+# watermark check lives here historically; tests import it from jobs)
 from ..utils import faults
 from ..utils.artifacts import ArtifactCorrupt, ArtifactStore
 from ..utils.health import HEALTH
@@ -101,18 +109,6 @@ def _compact_threshold() -> int:
 def _env_num(name: str, default: float) -> float:
     v = os.environ.get(name)
     return float(v) if v else default
-
-
-def rss_mb() -> float | None:
-    """Resident set size in MB via /proc/self/statm (no psutil). Returns
-    None where procfs is unavailable (macOS CI etc.) — the memory
-    watermark then degrades to a no-op rather than a crash."""
-    try:
-        with open("/proc/self/statm") as f:
-            pages = int(f.read().split()[1])
-        return pages * os.sysconf("SC_PAGE_SIZE") / (1024.0 * 1024.0)
-    except (OSError, IndexError, ValueError):
-        return None
 
 
 class ServiceOverloaded(RuntimeError):
@@ -150,6 +146,7 @@ class Job:
     result_digest: str | None = None    # sha256 of the offloaded artifact
     error: dict | None = None
     cancel_requested: bool = False
+    peak_rss_mb: float | None = None    # per-job RSS attribution (ISSUE 7)
 
     def public(self) -> dict:
         """Status view returned by getProofStatus (no result payload)."""
@@ -159,6 +156,8 @@ class Job:
              "submitted_at": self.submitted_at}
         if self.error is not None:
             d["error"] = self.error
+        if self.peak_rss_mb is not None:
+            d["peak_rss_mb"] = self.peak_rss_mb
         return d
 
 
@@ -225,10 +224,12 @@ class JobJournal:
                     job.result = rec.get("result")
                     job.result_digest = rec.get("result_digest")
                     job.finished_at = rec.get("ts")
+                    job.peak_rss_mb = rec.get("peak_rss_mb")
                 elif ev == "failed":
                     job.status = "failed"
                     job.error = rec.get("error")
                     job.finished_at = rec.get("ts")
+                    job.peak_rss_mb = rec.get("peak_rss_mb")
                 elif ev == "cancelled":
                     job.status = "cancelled"
                     job.finished_at = rec.get("ts")
@@ -271,6 +272,8 @@ class JobJournal:
                             rec["result"] = job.result
                         if job.error is not None:
                             rec["error"] = job.error
+                        if job.peak_rss_mb is not None:
+                            rec["peak_rss_mb"] = job.peak_rss_mb
                         recs.append(rec)
                     for rec in recs:
                         f.write(json.dumps(rec, sort_keys=True,
@@ -308,12 +311,15 @@ class JobQueue:
                  queue_depth: int | None = None,
                  mem_watermark_mb: float | None = None,
                  stall_timeout: float | None = None,
-                 clock=time.monotonic, sleep_interval: float | None = None):
+                 clock=time.monotonic, sleep_interval: float | None = None,
+                 latency_hist=None):
         """`queue_depth`/`mem_watermark_mb`/`stall_timeout` default to the
         SPECTRE_JOB_QUEUE_DEPTH / SPECTRE_MEM_WATERMARK_MB /
         SPECTRE_WORKER_STALL_S env knobs. `clock` and `sleep_interval` are
         the supervisor's injectable time source and scan period (the
-        BeaconClient pattern: stall tests run deterministic + fast)."""
+        BeaconClient pattern: stall tests run deterministic + fast).
+        `latency_hist` (injectable for tests) is the queue-local prove
+        latency histogram that prices `retry_after_s` at its p90."""
         self.runner = runner
         self.concurrency = max(1, int(concurrency))
         self.semaphore = semaphore
@@ -332,6 +338,11 @@ class JobQueue:
             stall_timeout if stall_timeout is not None
             else _env_num(WORKER_STALL_ENV, WORKER_STALL_DEFAULT_S))
         self._clock = clock
+        # retry_after pricing (ISSUE 7, closes the PR-6 follow-up): a
+        # queue-LOCAL histogram — p90 of what *this* queue observed, not
+        # a process-wide mean a single outlier can poison
+        self.latency = (latency_hist if latency_hist is not None
+                        else obs_metrics.queue_latency_histogram())
         self._jobs: dict[str, Job] = {}
         self._by_digest: dict[str, str] = {}
         self._q: queue.Queue = queue.Queue()
@@ -461,16 +472,38 @@ class JobQueue:
             rss = rss_mb()
             if rss is not None and rss >= self.mem_watermark_mb:
                 self.health.incr("jobs_shed_memory")
+                # attribution (ISSUE 7, closes the PR-6 follow-up): name
+                # the running jobs (and their RSS high-water marks) the
+                # shed protected the box from. No top-level job_id, so
+                # journal replay skips the record by design.
+                running = [{"job_id": j.id,
+                            "peak_rss_mb": RSS_SAMPLER.peak(j.id)}
+                           for j in self._jobs.values()
+                           if j.status == "running"]
+                try:
+                    self._append({"event": "shed_memory",
+                                  "ts": time.time(),
+                                  "rss_mb": round(rss, 1),
+                                  "running": running})
+                except Exception:
+                    self.health.incr("journal_write_failures")
                 raise ServiceOverloaded("memory watermark",
                                         self.retry_after_locked())
 
     def retry_after_locked(self) -> float:
         """Backoff hint for shed submissions: the backlog ahead of a
-        retrying client, priced at the observed mean prove latency."""
-        mean = self.health.mean("prove_latency_s", DEFAULT_PROVE_LATENCY_S)
+        retrying client, priced at the p90 of this queue's observed
+        prove latency (a single outlier must not inflate the hint the
+        way it inflates a mean — pinned in tests/test_observability.py).
+        Falls back to the ServiceHealth running mean until the queue has
+        completed a job of its own."""
+        p90 = self.latency.quantile(0.9)
+        if p90 is None:
+            p90 = self.health.mean("prove_latency_s",
+                                   DEFAULT_PROVE_LATENCY_S)
         backlog = sum(1 for j in self._jobs.values()
                       if j.status in ("queued", "running"))
-        est = mean * max(1.0, float(backlog)) / float(self.concurrency)
+        est = p90 * max(1.0, float(backlog)) / float(self.concurrency)
         return round(min(max(est, 1.0), 600.0), 3)
 
     def submit(self, method: str, params: dict,
@@ -603,6 +636,8 @@ class JobQueue:
                 rec["result"] = result
             if error is not None:
                 rec["error"] = error
+            if job.peak_rss_mb is not None:
+                rec["peak_rss_mb"] = job.peak_rss_mb
             self._append(rec)
         except Exception:
             # the in-memory state already transitioned; a journal failure
@@ -655,36 +690,54 @@ class JobQueue:
             sem = self.semaphore
             heartbeat = (lambda s=slot, j=jid: self._beat(s, j))
             t0 = time.time()
+            # per-job attribution (ISSUE 7): RSS peak + span trace for
+            # the runner's lifetime. prove runs ON this thread, so every
+            # profiling.phase below the runner attaches to the trace via
+            # the thread-local — no plumbing through prove_* signatures.
+            RSS_SAMPLER.start(jid)
             try:
                 if sem is not None:
                     sem.acquire()
                 try:
-                    if self._runner_heartbeat:
-                        result = self.runner(job.method, job.params,
-                                             heartbeat=heartbeat)
-                    else:
-                        result = self.runner(job.method, job.params)
+                    with obs_tracing.trace(jid):
+                        if self._runner_heartbeat:
+                            result = self.runner(job.method, job.params,
+                                                 heartbeat=heartbeat)
+                        else:
+                            result = self.runner(job.method, job.params)
                 finally:
                     if sem is not None:
                         sem.release()
             except faults.InjectedCrash:
                 # simulated hard kill: write NOTHING (that is the point —
                 # journal replay must recover a torn "running" state) and
-                # take this worker down like a dead process would
+                # take this worker down like a dead process would. The
+                # sampler entry is still released (a real dead process
+                # takes its sampler thread with it; this one is shared).
+                RSS_SAMPLER.finish(jid)
                 raise
             except Exception as exc:
+                peak = RSS_SAMPLER.finish(jid)
                 with self._cv:
                     if self._slots[slot]["job"] == jid:
                         self._slots[slot]["job"] = None
                     if not self._owns_slot(slot):
                         return      # disowned: replacement took the slot
                     if job.status == "running":
+                        job.peak_rss_mb = peak
                         self._finish_locked(job, "failed",
                                             error=_error_dict(exc))
                 self.health.incr("jobs_failed")
                 continue
-            # retry_after estimates feed on real observed latency
-            self.health.observe("prove_latency_s", time.time() - t0)
+            peak = RSS_SAMPLER.finish(jid)
+            # retry_after estimates feed on real observed latency: the
+            # running-mean gauge (healthz view + cold-start fallback),
+            # the queue-local p90 pricing histogram, and the registered
+            # exposition histogram
+            dt = time.time() - t0
+            self.health.observe("prove_latency_s", dt)
+            self.latency.observe(dt)
+            obs_metrics.PROVE_LATENCY.observe(dt)
             # offload the result OUTSIDE the lock (file IO); a write
             # failure (fault site artifact.write) fails the job, never
             # the queue
@@ -707,6 +760,7 @@ class JobQueue:
                     continue
                 if job.status != "running":
                     continue                    # expired meanwhile: discard
+                job.peak_rss_mb = peak
                 if offload_err is not None:
                     self._finish_locked(job, "failed", error=offload_err)
                     self.health.incr("jobs_failed")

@@ -24,6 +24,10 @@ Beyond the reference (PR 3, resilient service):
   never leak to the wire as a bogus "parse error".
 * **Health** — the `health` RPC method and GET `/healthz` surface the
   ServiceHealth degradation counters (utils/health.py) plus queue stats.
+* **Observability (ISSUE 7)** — GET `/metrics` serves Prometheus text
+  exposition (observability/prom.py, counter parity with /healthz);
+  `getTrace` returns a completed job's span tree as Chrome trace-event
+  JSON (observability/tracing.py).
 """
 
 from __future__ import annotations
@@ -35,6 +39,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..preprocessor.rotation import rotation_args_from_update
 from ..preprocessor.step import step_args_from_finality_update
 from ..utils.health import HEALTH
+from ..utils.profiling import phase
 from .calldata import encode_calldata
 from .jobs import ServiceOverloaded, ensure_jobs
 from .state import ProverState
@@ -82,11 +87,12 @@ def run_proof_method(state, method: str, params: dict,
     prove phases."""
     if method == RPC_METHOD_STEP:
         spec = state.spec
-        args = step_args_from_finality_update(
-            params["light_client_finality_update"],
-            params["pubkeys"],
-            bytes.fromhex(params["domain"].removeprefix("0x")),
-            spec)
+        with phase("job/preprocess"):
+            args = step_args_from_finality_update(
+                params["light_client_finality_update"],
+                params["pubkeys"],
+                bytes.fromhex(params["domain"].removeprefix("0x")),
+                spec)
         proof, instances = _prove_call(state.prove_step, args, heartbeat)
         return {
             "proof": "0x" + proof.hex(),
@@ -94,8 +100,9 @@ def run_proof_method(state, method: str, params: dict,
             "calldata": "0x" + encode_calldata(instances, proof).hex(),
         }
     if method == RPC_METHOD_COMMITTEE:
-        args = rotation_args_from_update(
-            params["light_client_update"], state.spec)
+        with phase("job/preprocess"):
+            args = rotation_args_from_update(
+                params["light_client_update"], state.spec)
         proof, instances = _prove_call(state.prove_committee, args,
                                        heartbeat)
         # compressed layout: 12 accumulator limbs then app instances,
@@ -151,6 +158,18 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):
+        if self.path == "/metrics":
+            # Prometheus scrape (ISSUE 7): text exposition 0.0.4 with
+            # exact counter parity against /healthz (both read the same
+            # HEALTH.snapshot())
+            from ..observability import prom
+            body = prom.render(jobs=self.jobs).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", prom.CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if self.path not in ("/healthz", "/health"):
             self.send_error(404)
             return
@@ -259,6 +278,25 @@ class _Handler(BaseHTTPRequestHandler):
             result = job.result
         elif method == "cancelProof":
             result = {"cancelled": self.jobs.cancel(params["job_id"])}
+        elif method == "getTrace":
+            # per-job span tree as Chrome trace-event JSON (ISSUE 7);
+            # trace id = job id, retained for the last
+            # SPECTRE_TRACE_KEEP completed jobs
+            from ..observability import tracing
+            jid = params["job_id"]
+            tr = tracing.get_trace(jid)
+            if tr is None:
+                st = self.jobs.status(jid) if self.jobs else None
+                if st is None:
+                    return _error(JOB_NOT_FOUND, f"unknown job {jid}", id_)
+                if st["status"] in ("queued", "running"):
+                    return _error(JOB_NOT_DONE,
+                                  f"job {jid} is {st['status']}; no trace "
+                                  f"yet", id_)
+                return _error(JOB_NOT_FOUND,
+                              f"trace for job {jid} expired from the "
+                              f"retention ring", id_)
+            result = tracing.chrome_trace(tr)
         elif method == "health":
             from ..preprocessor.beacon import breaker_snapshot
             result = HEALTH.snapshot()

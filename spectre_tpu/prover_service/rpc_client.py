@@ -201,3 +201,22 @@ class ProverClient:
 
     def health(self) -> dict:
         return self._call("health", {}, timeout=min(self.timeout, 30.0))
+
+    # -- observability (ISSUE 7) -------------------------------------------
+
+    def get_trace(self, job_id: str) -> dict:
+        """Chrome trace-event JSON for a completed job (trace id = job
+        id). Raises RpcError -32002 while the job is still live, -32004
+        for unknown jobs / traces past the retention ring."""
+        return self._call("getTrace", {"job_id": job_id},
+                          timeout=min(self.timeout, 30.0))
+
+    def metrics_text(self) -> str:
+        """Raw GET /metrics body (Prometheus text exposition 0.0.4) from
+        the same host as the RPC endpoint."""
+        from urllib.parse import urlsplit, urlunsplit
+        parts = urlsplit(self.url)
+        url = urlunsplit((parts.scheme, parts.netloc, "/metrics", "", ""))
+        with urllib.request.urlopen(
+                url, timeout=min(self.timeout, 30.0)) as resp:
+            return resp.read().decode()

@@ -18,6 +18,7 @@ import threading
 from ..models import CommitteeUpdateCircuit, StepCircuit
 from ..plonk import backend as B
 from ..plonk.srs import SRS
+from ..utils.profiling import phase
 from ..witness import default_committee_update_args, default_sync_step_args
 
 
@@ -86,15 +87,17 @@ class ProverState:
         from ..plonk.transcript import KeccakTranscript, PoseidonTranscript
         hb = heartbeat or (lambda: None)
         bk = bk if bk is not None else self.backend
-        app_proof = circuit.prove(pk, self.srs[k], args, self.spec, bk,
-                                  transcript=PoseidonTranscript())
+        with phase("prove/app_snark"):
+            app_proof = circuit.prove(pk, self.srs[k], args, self.spec, bk,
+                                      transcript=PoseidonTranscript())
         hb()              # phase boundary: app snark done, aggregation next
         inst = circuit.get_instances(args, self.spec)
         agg_args = AggregationArgs(inner_vk=pk.vk, srs=self.srs[k],
                                    inner_instances=[inst], proof=app_proof)
-        outer = agg_cls.prove(agg_pk, self.srs[self.k_agg], agg_args,
-                              self.spec, bk,
-                              transcript=KeccakTranscript())
+        with phase("prove/aggregation"):
+            outer = agg_cls.prove(agg_pk, self.srs[self.k_agg], agg_args,
+                                  self.spec, bk,
+                                  transcript=KeccakTranscript())
         hb()
         return outer, AggregationCircuit.get_instances(agg_args, self.spec)
 

@@ -20,6 +20,9 @@ Injection sites threaded through the codebase:
     journal.compact prover_service/jobs.py  staged-sidecar swap window
     artifact.write  utils/artifacts.py      result-file atomic write
     artifact.read   utils/artifacts.py      result-file read + verify
+    metrics.write   utils/profiling.py      SPECTRE_METRICS JSONL append
+                                            (a broken metrics sink must
+                                            never fail a prove)
 
 Kinds and the exception they raise:
 

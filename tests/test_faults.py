@@ -974,3 +974,27 @@ class TestSrsChecksum:
         SRS.load_or_setup(4, str(tmp_path))
         (tmp_path / ("kzg_bn254_4.srs" + SIDECAR_SUFFIX)).unlink()
         assert SRS.read(str(tmp_path / "kzg_bn254_4.srs")).k == 4
+
+
+class TestMetricsSinkFault:
+    """ISSUE 7 satellite: the SPECTRE_METRICS JSONL sink is best-effort —
+    a broken sink (full disk, revoked fd) must NEVER fail the prove it is
+    observing; it counts on health and the next phase writes through."""
+
+    def test_broken_sink_never_fails_a_prove(self, tmp_path, monkeypatch):
+        from spectre_tpu.utils import profiling as prof
+        sink = tmp_path / "metrics.jsonl"
+        monkeypatch.setenv("SPECTRE_METRICS", str(sink))
+        faults.install_plan("metrics.write:ioerror:1")
+        before = HEALTH.get("metrics_write_failures")
+        with prof.phase("sink-test-phase"):          # must not raise
+            pass
+        assert faults.fired_count("metrics.write") == 1
+        assert HEALTH.get("metrics_write_failures") == before + 1
+        assert not sink.exists()                     # faulted append skipped
+        with prof.phase("sink-test-phase"):          # disarmed: writes thru
+            pass
+        lines = [json.loads(l) for l in sink.read_text().splitlines()]
+        assert len(lines) == 1
+        assert lines[0]["phase"] == "sink-test-phase"
+        assert lines[0]["seconds"] >= 0
