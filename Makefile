@@ -18,9 +18,12 @@ test: native lint test-faults bench-fast
 # SRS checksum refusal, overload RPC contract (429/-32001/Retry-After),
 # and the observability tier (PR 7): /metrics exposition parity,
 # getTrace span trees, peak-RSS attribution, broken-metrics-sink
-# tolerance. Also part of the full pytest ladder above.
+# tolerance. PR 8 adds the provenance-manifest tier (test_manifest.py):
+# end-to-end manifest pins, compile telemetry, queue-wait parity,
+# manifest.write fault tolerance, crash-replay without a manifest.
+# Also part of the full pytest ladder above.
 test-faults: native
-	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py tests/test_service.py tests/test_observability.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py tests/test_service.py tests/test_observability.py tests/test_manifest.py -q
 
 test-slow: native
 	RUN_SLOW=1 python -m pytest tests/ -q

@@ -165,15 +165,19 @@ def device_phase(out_path: str):
     # span-traced phases (ISSUE 7): bench JSON carries the SAME
     # phase_seconds schema production traces expose via getTrace, and
     # running the gated floors with tracing active doubles as the
-    # instrumentation-overhead gate
-    from spectre_tpu.observability import tracing
+    # instrumentation-overhead gate. Compile telemetry (ISSUE 8) rides
+    # the same runs: the jax.monitoring hook splits compile_seconds out
+    # of the record so floors keep gating steady-state run time only.
+    from spectre_tpu.observability import compilelog, tracing
     from spectre_tpu.utils.profiling import phase
+    compilelog.install()
 
     mismatch = None
     infra_fail = None
     for impl_name, run in impls:
         try:
-            with tracing.trace(f"bench-msm-{impl_name}") as tr:
+            with tracing.trace(f"bench-msm-{impl_name}") as tr, \
+                    compilelog.capture() as cev:
                 with phase("bench/warmup_compile"):
                     # compile + first run (+ fixed-base table build)
                     res = run()
@@ -197,11 +201,14 @@ def device_phase(out_path: str):
             continue
         if F._USE_MXU:
             impl_name += "+mxu"    # SPECTRE_FIELD_IMPL=mxu matmul field path
+        comp = compilelog.summarize(cev)
         with open(out_path, "w") as f:
             json.dump({"points_per_s": n / dt, "impl": impl_name,
                        "msm_mode": mode if impl_name.startswith("aos")
                        else "vanilla",
                        "phase_seconds": tracing.phase_seconds(tr),
+                       "compile_seconds": comp["seconds"],
+                       "compile_count": comp["count"],
                        "backend": jax.default_backend()}, f)
         return
     if mismatch:
@@ -273,11 +280,14 @@ def ntt_device_phase(out_path: str):
         return np.asarray(NTT.coset_lde_std(stack_d, omega_ext, g,
                                             mode=mode))
 
-    # span-traced phases (ISSUE 7): same schema as the MSM child / getTrace
-    from spectre_tpu.observability import tracing
+    # span-traced phases (ISSUE 7): same schema as the MSM child / getTrace;
+    # compile telemetry (ISSUE 8) separates compile from throughput
+    from spectre_tpu.observability import compilelog, tracing
     from spectre_tpu.utils.profiling import phase
+    compilelog.install()
 
-    with tracing.trace(f"bench-ntt-{mode}") as tr:
+    with tracing.trace(f"bench-ntt-{mode}") as tr, \
+            compilelog.capture() as cev:
         # compile + correctness gate: the batched fused kernel must be
         # BYTE-IDENTICAL to the per-column jitted loop (exact arithmetic)
         with phase("bench/byte_check"):
@@ -320,12 +330,15 @@ def ntt_device_phase(out_path: str):
                 run_batched()
                 dt = min(dt, time.time() - t0)
 
+        comp = compilelog.summarize(cev)
         with open(out_path, "w") as f:
             json.dump({"polys_per_s": batch / dt,
                        "baseline_polys_per_s": batch / base_dt,
                        "jitted_loop_polys_per_s": batch / jl_dt,
                        "ntt_mode": mode, "impl": "batched",
                        "phase_seconds": tracing.phase_seconds(tr),
+                       "compile_seconds": comp["seconds"],
+                       "compile_count": comp["count"],
                        "backend": jax.default_backend()}, f)
 
 
@@ -467,6 +480,11 @@ def bench_msm(fast: bool) -> bool:
         # per-phase breakdown from the child's span trace (ISSUE 7) —
         # the same schema getTrace/phase_seconds exposes in production
         record["phase_seconds"] = result["phase_seconds"]
+    if result.get("compile_seconds") is not None:
+        # JIT compile cost recorded separately from steady-state
+        # throughput (ISSUE 8): floors keep gating run time only
+        record["compile_seconds"] = result["compile_seconds"]
+        record["compile_count"] = result.get("compile_count", 0)
     return _emit(record, fast, f"bn254_msm_2^{logn}_cpu_points_per_s",
                  "points/s")
 
@@ -523,6 +541,9 @@ def bench_ntt(fast: bool) -> bool:
         record["vs_jitted_loop"] = round(value / jl, 3)
     if result.get("phase_seconds"):
         record["phase_seconds"] = result["phase_seconds"]
+    if result.get("compile_seconds") is not None:
+        record["compile_seconds"] = result["compile_seconds"]
+        record["compile_count"] = result.get("compile_count", 0)
     return _emit(record, fast, f"bn254_ntt_2^{logn}_cpu_polys_per_s",
                  "polys/s")
 

@@ -1,6 +1,6 @@
 """spectre_tpu.observability — the telemetry spine of the prover service.
 
-Four pieces, one principle (bridge, don't duplicate):
+Six pieces, one principle (bridge, don't duplicate):
 
 * :mod:`.metrics` — counters/gauges/fixed-bucket histograms; the
   prove-latency and per-phase histograms ServiceHealth's running means
@@ -13,12 +13,20 @@ Four pieces, one principle (bridge, don't duplicate):
   `utils/profiling.phase`; Chrome trace-event export via the `getTrace`
   RPC and the SPECTRE_TRACE_DIR file sink.
 * :mod:`.rss` — per-job peak-RSS attribution from /proc/self/statm.
+* :mod:`.manifest` — per-proof provenance manifests (PR 8): timestamps,
+  modes/knobs, degrade+fault events, LRU deltas, compile events, phase
+  seconds, result digest; stored content-addressed, journal keeps only
+  the digest; `getProofManifest` RPC + `report` CLI.
+* :mod:`.compilelog` — jax.monitoring compile-duration listener feeding
+  `spectre_compile_seconds{fn=}`, nested `compile/*` trace spans and the
+  per-job manifest capture (jax imported lazily inside `install()`).
 
 Import order matters downstream: utils/profiling.py imports
 `.metrics`/`.tracing` (both stdlib-only), so nothing here may import
 the service layer or jax at module scope.
 """
 
-from . import metrics, prom, rss, tracing
+from . import metrics, rss, tracing          # noqa: F401  (stdlib-only)
+from . import compilelog, manifest, prom     # noqa: F401  (build on the above)
 
-__all__ = ["metrics", "prom", "rss", "tracing"]
+__all__ = ["compilelog", "manifest", "metrics", "prom", "rss", "tracing"]

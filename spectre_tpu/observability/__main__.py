@@ -1,0 +1,74 @@
+"""`python -m spectre_tpu.observability` — operator tooling.
+
+Subcommands:
+
+  report <job-id|manifest.json> [--diff <job-id|manifest.json>] [--url U]
+      Render a proof provenance manifest (observability/manifest.py) as
+      a phase/compile/queue-wait breakdown. The target is either a path
+      to a manifest JSON file (as stored in the artifact store /
+      downloaded earlier) or a job id, fetched live over the
+      `getProofManifest` RPC from --url. `--diff` renders the breakdown
+      of the first manifest followed by a field-by-field regression
+      diff against the second — the triage loop for "why did tonight's
+      prove get slower".
+
+Stdlib-only: rendering a manifest must work on a laptop with neither
+jax nor the prover installed beyond this package.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import manifest as man_mod
+
+DEFAULT_URL = "http://127.0.0.1:3000/rpc"
+
+
+def _load(target: str, url: str) -> dict:
+    """A target that exists on disk is a manifest file; anything else is
+    treated as a job id and fetched over RPC."""
+    if os.path.exists(target):
+        with open(target, "rb") as f:
+            return man_mod.from_bytes(f.read())
+    from ..prover_service.rpc_client import ProverClient
+    return ProverClient(url).get_manifest(target)
+
+
+def _cmd_report(args) -> int:
+    a = _load(args.target, args.url)
+    print(man_mod.render(a))
+    if args.diff is not None:
+        b = _load(args.diff, args.url)
+        print()
+        print(man_mod.diff(a, b))
+    if args.json:
+        print()
+        print(json.dumps(a, indent=2, sort_keys=True))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m spectre_tpu.observability")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    r = sub.add_parser("report", help="render a proof provenance manifest")
+    r.add_argument("target",
+                   help="manifest JSON path, or a job id (fetched via RPC)")
+    r.add_argument("--diff", default=None, metavar="OTHER",
+                   help="second manifest (path or job id) to diff against")
+    r.add_argument("--url", default=DEFAULT_URL,
+                   help=f"prover RPC endpoint for job-id targets "
+                        f"(default {DEFAULT_URL})")
+    r.add_argument("--json", action="store_true",
+                   help="also dump the raw manifest JSON")
+    args = p.parse_args(argv)
+    if args.cmd == "report":
+        return _cmd_report(args)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,17 @@ LATENCY_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
 PHASE_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                  5.0, 10.0, 30.0, 60.0, 300.0)
 
+# queue wait (admission -> worker start): near-zero on an idle box, up
+# to the admission controller's 600s retry_after cap (and beyond, when
+# a replayed journal re-queues jobs across an outage)
+QUEUE_WAIT_BUCKETS = (0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                      30.0, 60.0, 300.0, 600.0, 1800.0)
+
+# XLA compile durations: jaxpr traces are ~ms, backend_compile of a
+# large quotient kernel can run minutes on first prove
+COMPILE_BUCKETS = (0.001, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                   10.0, 30.0, 60.0, 300.0)
+
 
 class Counter:
     kind = "counter"
@@ -244,6 +255,25 @@ PHASE_SECONDS = REGISTRY.histogram_vec(
     "spectre_phase_seconds",
     "Wall-clock seconds per instrumented prover phase",
     PHASE_BUCKETS, ("phase",))
+
+
+# admission -> worker-start wait, observed by the JobQueue worker with
+# the SAME value the job's provenance manifest records as queue_wait_s
+# (tests pin exact parity) — splits queueing from proving in the
+# latency story that spectre_prove_latency_seconds alone conflates
+QUEUE_WAIT = REGISTRY.histogram(
+    "spectre_queue_wait_seconds",
+    "Seconds between job admission and worker start",
+    QUEUE_WAIT_BUCKETS)
+
+# XLA backend-compile seconds attributed to the prover phase (fn label)
+# that was open when the compile fired; fed by observability/compilelog
+# from jax.monitoring events. Zero observations after warmup = the jit
+# caches are doing their job.
+COMPILE_SECONDS = REGISTRY.histogram_vec(
+    "spectre_compile_seconds",
+    "XLA backend compile seconds per triggering prover phase",
+    COMPILE_BUCKETS, ("fn",))
 
 
 def queue_latency_histogram() -> Histogram:

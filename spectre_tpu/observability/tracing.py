@@ -121,6 +121,35 @@ def span(name: str):
             _local.stack.pop()
 
 
+def current_span_name() -> str | None:
+    """Name of the innermost open span on this thread, or None when no
+    trace is active. The compile-telemetry listener uses this to label
+    `spectre_compile_seconds{fn=}` with the phase that triggered the
+    compile (e.g. `prove/commit_advice`)."""
+    tr = _local.trace
+    if tr is None or not _local.stack:
+        return None
+    return _local.stack[-1].name
+
+
+def add_completed_span(name: str, seconds: float, **meta):
+    """Append an already-finished child span (ending now) under the
+    innermost open span; no-op without a trace. This is how events timed
+    elsewhere — XLA compile durations reported by `jax.monitoring` —
+    land in the tree as `compile/*` children of the phase that was open
+    while they ran."""
+    tr = _local.trace
+    if tr is None or not _local.stack:
+        return None
+    t1 = time.perf_counter()
+    s = Span(name, t1 - max(0.0, float(seconds)))
+    s.t1 = t1
+    if meta:
+        s.meta.update(meta)
+    _local.stack[-1].children.append(s)
+    return s
+
+
 def annotate(**kw):
     """Attach key/values to the innermost open span (exported as Chrome
     `args`) — e.g. the CPU-fallback path stamps its oom/compile kind."""

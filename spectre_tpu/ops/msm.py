@@ -291,11 +291,15 @@ class _TableLRU:
     of per-stage arrays, so byte accounting sums over sequence entries."""
 
     def __init__(self, budget_bytes: int, label: str = "msm fixed-base table",
-                 budget_var: str = "SPECTRE_MSM_TABLE_MB"):
+                 budget_var: str = "SPECTRE_MSM_TABLE_MB", on_event=None):
         import collections
         self.budget = budget_bytes
         self.label = label
         self.budget_var = budget_var
+        # best-effort `fn(kind, **detail)` hook (provenance-manifest event
+        # recorder): fires on evictions and oversize passthroughs so cache
+        # churn during a prove lands in that job's manifest
+        self.on_event = on_event
         self._d = collections.OrderedDict()   # key -> (base_ref, table)
         self._bytes = 0
         self.hits = 0
@@ -333,12 +337,20 @@ class _TableLRU:
                   f"{self.budget_var} budget ({self.budget >> 20} MB): "
                   f"uncached — every use rebuilds it",
                   file=sys.stderr, flush=True)
+            if self.on_event is not None:
+                self.on_event("lru_oversize", cache=self.label,
+                              entry_mb=nbytes >> 20,
+                              budget_mb=self.budget >> 20)
             return table
+        evicted = 0
         while self._bytes + nbytes > self.budget and self._d:
             _k, (_ref, old) = self._d.popitem(last=False)
             self._bytes -= self._entry_bytes(old)
             self.evictions += 1
+            evicted += 1
             self._evicted_keys.add(_k)
+        if evicted and self.on_event is not None:
+            self.on_event("lru_evictions", cache=self.label, count=evicted)
         self._d[key] = (base, table)
         self._bytes += nbytes
         return table
@@ -365,7 +377,14 @@ def _table_budget_bytes() -> int:
     return min(8 << 30, int(total * 0.25))
 
 
-_TABLES = _TableLRU(_table_budget_bytes())
+def _record_event(kind, **detail):
+    """Forward cache/degrade events to the per-job provenance-manifest
+    collector (no-op outside a collecting job; stdlib-only import)."""
+    from ..observability.manifest import record_event
+    record_event(kind, **detail)
+
+
+_TABLES = _TableLRU(_table_budget_bytes(), on_event=_record_event)
 
 
 def lru_stats() -> dict:
@@ -409,6 +428,9 @@ def _degrade_fixed(n: int, c: int, nbits: int) -> bool:
         return False
     from ..utils.health import HEALTH
     HEALTH.incr("msm_fixed_degraded")
+    _record_event("msm_fixed_degraded", n=n, window=c,
+                  table_mb=_fixed_table_bytes(n, c, nbits) >> 20,
+                  budget_mb=_TABLES.budget >> 20)
     return True
 
 

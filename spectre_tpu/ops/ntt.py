@@ -49,7 +49,7 @@ import numpy as np
 
 from ..fields import bn254
 from . import field_ops as F
-from .msm import _TableLRU
+from .msm import _TableLRU, _record_event
 
 R = bn254.R
 
@@ -96,7 +96,8 @@ def _table_budget_bytes() -> int:
 
 
 _TABLES = _TableLRU(_table_budget_bytes(), label="ntt twiddle/coset table",
-                    budget_var="SPECTRE_NTT_TABLE_MB")
+                    budget_var="SPECTRE_NTT_TABLE_MB",
+                    on_event=_record_event)
 
 
 def lru_stats() -> dict:

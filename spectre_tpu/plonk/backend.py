@@ -585,10 +585,13 @@ def prove_with_fallback(prove_fn, bk, health=None):
             raise                     # already on the fallback tier
         kind = "oom" if is_device_oom(exc) else "compile"
         health.incr(f"prove_cpu_fallbacks_{kind}")
-        # stamp the degradation onto the job's span tree: a trace whose
-        # prove ran on the fallback tier must say so (getTrace `args`)
-        from ..observability import tracing
+        # stamp the degradation onto the job's span tree (getTrace
+        # `args`) AND the job's provenance manifest: a proof produced on
+        # the fallback tier must say so everywhere it is inspected
+        from ..observability import manifest, tracing
         tracing.annotate(cpu_fallback=kind)
+        manifest.record_event("cpu_fallback", fallback_kind=kind,
+                              from_backend=getattr(bk, "name", "device"))
         import sys
         print(f"[prover] device prove failed ({kind}: {exc}); retrying "
               f"once on the CPU backend", file=sys.stderr, flush=True)

@@ -381,6 +381,13 @@ class _BudgetedExtLRU:
                 worst = sorted(self._evicted.items(), key=lambda kv: -kv[1])
                 fams = ", ".join(f"{k[0] if isinstance(k, tuple) else k}"
                                  f" x{c}" for k, c in worst[:4])
+                # stamp the thrash into the job's provenance manifest —
+                # "raise SPECTRE_QUOTIENT_CACHE_MB" advice must survive
+                # past this process's stderr
+                from ..observability.manifest import record_event
+                record_event("quotient_cache_thrash",
+                             recomputes=self.recompute_count,
+                             budget_mb=self.budget >> 20)
                 print(f"[quotient] extended-array cache thrashing: "
                       f"{self.recompute_count} recomputes after eviction "
                       f"(budget {self.budget >> 20} MB; hottest evicted "

@@ -179,6 +179,17 @@ class BeaconClient:
     # -- retried GET -------------------------------------------------------
 
     def _get(self, path: str) -> dict:
+        # spanned (ISSUE 8): beacon IO becomes a real `beacon/fetch`
+        # child under job/preprocess in getTrace (and the phase
+        # histogram) instead of unattributed converter time; the span
+        # covers the FULL retry loop, annotated with path + attempts
+        from ..observability import tracing
+        from ..utils.profiling import phase
+        with phase("beacon/fetch"):
+            tracing.annotate(path=path)
+            return self._get_retrying(path)
+
+    def _get_retrying(self, path: str) -> dict:
         self._breaker_admit()
         url = self.base_url + path
         deadline = time.time() + self.total_timeout

@@ -10,6 +10,7 @@ the PUBKEYS root, not the SyncCommittee container root, is the proven leaf).
 from __future__ import annotations
 
 from ..gadgets.ssz_merkle import verify_merkle_proof_native
+from ..utils.profiling import phase
 from ..witness.types import CommitteeUpdateArgs, bytes48_root
 from .step import _b32, _bytes, _hdr
 
@@ -36,8 +37,11 @@ def rotation_args_from_update(update: dict, spec) -> CommitteeUpdateArgs:
         finalized_header=finalized,
         sync_committee_branch=branch,
     )
-    assert verify_merkle_proof_native(
-        args.committee_pubkeys_root(), branch,
-        spec.sync_committee_pubkeys_root_index, finalized.state_root), \
-        "sync committee branch does not verify"
+    # spanned (ISSUE 8): hashing 512 pubkeys into the committee root is
+    # the dominant cost here and belongs under job/preprocess in traces
+    with phase("preprocess/verify_branches"):
+        assert verify_merkle_proof_native(
+            args.committee_pubkeys_root(), branch,
+            spec.sync_committee_pubkeys_root_index, finalized.state_root), \
+            "sync committee branch does not verify"
     return args

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from ..fields import bls12_381 as bls
 from ..gadgets.ssz_merkle import verify_merkle_proof_native
+from ..utils.profiling import phase
 from ..witness.types import BeaconBlockHeader, SyncStepArgs
 
 
@@ -47,21 +48,25 @@ def step_args_from_finality_update(update: dict, pubkeys_compressed: list,
     exec_root = _b32(update["execution_payload_root"])
     exec_branch = [_b32(b) for b in update["execution_branch"]]
 
-    # native branch verification (reference `step.rs:90-120`)
-    assert verify_merkle_proof_native(
-        finalized.hash_tree_root(), fin_branch,
-        spec.finalized_header_index, attested.state_root), \
-        "finality branch does not verify"
-    assert verify_merkle_proof_native(
-        exec_root, exec_branch,
-        spec.execution_state_root_index, finalized.body_root), \
-        "execution branch does not verify"
+    # native branch verification (reference `step.rs:90-120`); spanned
+    # (ISSUE 8) so `job/preprocess` has real children in getTrace
+    with phase("preprocess/verify_branches"):
+        assert verify_merkle_proof_native(
+            finalized.hash_tree_root(), fin_branch,
+            spec.finalized_header_index, attested.state_root), \
+            "finality branch does not verify"
+        assert verify_merkle_proof_native(
+            exec_root, exec_branch,
+            spec.execution_state_root_index, finalized.body_root), \
+            "execution branch does not verify"
 
     bits = _participation_bits(update["sync_aggregate"]["sync_committee_bits"],
                                spec.sync_committee_size)
-    from ..ops.field384 import g1_decompress_batch
-    pubkeys = [(bls.Fq(x), bls.Fq(y)) for x, y in
-               g1_decompress_batch([_bytes(pk) for pk in pubkeys_compressed])]
+    with phase("preprocess/decompress_pubkeys"):
+        from ..ops.field384 import g1_decompress_batch
+        pubkeys = [(bls.Fq(x), bls.Fq(y)) for x, y in
+                   g1_decompress_batch([_bytes(pk)
+                                        for pk in pubkeys_compressed])]
     assert len(pubkeys) == spec.sync_committee_size
 
     args = SyncStepArgs(
@@ -78,11 +83,12 @@ def step_args_from_finality_update(update: dict, pubkeys_compressed: list,
     )
 
     # native signature verification (reject before proving)
-    participating = [p for p, b in zip(pubkeys, bits) if b]
-    sig = bls.g2_decompress(args.signature_compressed)
-    assert bls.fast_aggregate_verify(participating, args.signing_root(), sig,
-                                     dst=spec.dst), \
-        "aggregate signature does not verify"
+    with phase("preprocess/verify_signature"):
+        participating = [p for p, b in zip(pubkeys, bits) if b]
+        sig = bls.g2_decompress(args.signature_compressed)
+        assert bls.fast_aggregate_verify(participating, args.signing_root(),
+                                         sig, dst=spec.dst), \
+            "aggregate signature does not verify"
     return args
 
 

@@ -90,6 +90,11 @@ def main(argv=None):
     elif args.cmd == "rpc":
         from .rpc import serve
         from .state import ProverState
+        # compile telemetry before the first jit: boot/pk-creation
+        # compiles land in spectre_compile_seconds and per-job manifests
+        # (render one with `python -m spectre_tpu.observability report`)
+        from ..observability import compilelog
+        compilelog.install()
         print(f"loading prover state (spec={spec.name}, backend={args.backend})...",
               flush=True)
         state = ProverState(spec, args.k_step, args.k_committee,

@@ -55,9 +55,20 @@ Overload + integrity layer (ISSUE 6):
   quarantines corrupt files (the job degrades to failed + re-provable)
   instead of serving poison.
 
+Provenance manifests (ISSUE 8): every job that reaches a worker also
+emits a per-proof manifest (observability/manifest.py — timestamps with
+queue wait split out, resolved modes + env knobs, degrade/fault events,
+MSM/NTT table-LRU deltas, JIT compile events, phase seconds, peak RSS,
+result digest). Manifests are artifacts (`<sha256>.manifest.json` via
+utils/artifacts, journal stores only the digest) and are IO-tolerant
+like the metrics sink: fault site `manifest.write`, counter
+`manifest_write_failures` — a broken manifest sink never fails a prove,
+the manifest just degrades to absent (`getProofManifest` → unavailable).
+
 Fault-injection sites: `journal.write` fires inside the append path so CI
 can prove a journal-write failure fails the job rather than wedging the
-queue; `artifact.write`/`artifact.read` cover the result store.
+queue; `artifact.write`/`artifact.read` cover the result store;
+`manifest.write` covers the manifest sink.
 """
 
 from __future__ import annotations
@@ -71,6 +82,8 @@ import queue
 import threading
 import time
 
+from ..observability import compilelog as obs_compilelog
+from ..observability import manifest as obs_manifest
 from ..observability import metrics as obs_metrics
 from ..observability import tracing as obs_tracing
 from ..observability.rss import SAMPLER as RSS_SAMPLER
@@ -138,6 +151,7 @@ class Job:
     digest: str
     status: str = "queued"
     submitted_at: float = 0.0
+    admitted_at: float | None = None    # admission-control pass (ISSUE 8)
     started_at: float | None = None
     finished_at: float | None = None
     timeout: float | None = None
@@ -147,6 +161,8 @@ class Job:
     error: dict | None = None
     cancel_requested: bool = False
     peak_rss_mb: float | None = None    # per-job RSS attribution (ISSUE 7)
+    queue_wait_s: float | None = None   # admission -> worker start
+    manifest_digest: str | None = None  # provenance manifest artifact
 
     def public(self) -> dict:
         """Status view returned by getProofStatus (no result payload)."""
@@ -158,6 +174,10 @@ class Job:
             d["error"] = self.error
         if self.peak_rss_mb is not None:
             d["peak_rss_mb"] = self.peak_rss_mb
+        if self.queue_wait_s is not None:
+            d["queue_wait_s"] = self.queue_wait_s
+        if self.manifest_digest is not None:
+            d["manifest_digest"] = self.manifest_digest
         return d
 
 
@@ -205,6 +225,7 @@ class JobJournal:
                         params=rec.get("params") or {},
                         digest=rec.get("digest", ""),
                         submitted_at=rec.get("ts", 0.0),
+                        admitted_at=rec.get("admitted"),
                         timeout=rec.get("timeout"))
                     continue
                 job = jobs.get(jid)
@@ -225,11 +246,13 @@ class JobJournal:
                     job.result_digest = rec.get("result_digest")
                     job.finished_at = rec.get("ts")
                     job.peak_rss_mb = rec.get("peak_rss_mb")
+                    job.manifest_digest = rec.get("manifest_digest")
                 elif ev == "failed":
                     job.status = "failed"
                     job.error = rec.get("error")
                     job.finished_at = rec.get("ts")
                     job.peak_rss_mb = rec.get("peak_rss_mb")
+                    job.manifest_digest = rec.get("manifest_digest")
                 elif ev == "cancelled":
                     job.status = "cancelled"
                     job.finished_at = rec.get("ts")
@@ -256,10 +279,13 @@ class JobJournal:
         with self._lock:
             with open(tmp, "w") as f:
                 for job in sorted(jobs, key=lambda j: j.submitted_at):
-                    recs = [{"event": "submit", "job_id": job.id,
-                             "method": job.method, "params": job.params,
-                             "digest": job.digest, "timeout": job.timeout,
-                             "ts": job.submitted_at}]
+                    sub = {"event": "submit", "job_id": job.id,
+                           "method": job.method, "params": job.params,
+                           "digest": job.digest, "timeout": job.timeout,
+                           "ts": job.submitted_at}
+                    if job.admitted_at is not None:
+                        sub["admitted"] = job.admitted_at
+                    recs = [sub]
                     if job.status in TERMINAL:
                         rec = {"event": job.status, "job_id": job.id,
                                "ts": job.finished_at}
@@ -274,6 +300,10 @@ class JobJournal:
                             rec["error"] = job.error
                         if job.peak_rss_mb is not None:
                             rec["peak_rss_mb"] = job.peak_rss_mb
+                        # the manifest stays an O(1) digest through
+                        # compaction, exactly like the result artifact
+                        if job.manifest_digest is not None:
+                            rec["manifest_digest"] = job.manifest_digest
                         recs.append(rec)
                     for rec in recs:
                         f.write(json.dumps(rec, sort_keys=True,
@@ -496,7 +526,16 @@ class JobQueue:
         prove latency (a single outlier must not inflate the hint the
         way it inflates a mean — pinned in tests/test_observability.py).
         Falls back to the ServiceHealth running mean until the queue has
-        completed a job of its own."""
+        completed a job of its own.
+
+        Note on wait vs prove (ISSUE 8): the p90 here covers the PROVE
+        only (worker start -> finish); the time a job spends queued is
+        modelled by the `backlog / concurrency` factor. The observed
+        split is exported separately — `spectre_queue_wait_seconds`
+        (admission -> start) vs `spectre_prove_latency_seconds` — and
+        every manifest records its own `queue_wait_s`/`prove_s`, so an
+        inflated retry hint can be attributed to queueing or to slow
+        proves, not guessed at."""
         p90 = self.latency.quantile(0.9)
         if p90 is None:
             p90 = self.health.mean("prove_latency_s",
@@ -514,6 +553,7 @@ class JobQueue:
         job that gives up by then rather than burning a worker on a
         result nobody will read. Raises :class:`ServiceOverloaded` when
         admission control sheds the submission."""
+        arrival = time.time()           # request arrival, pre-admission
         digest = witness_digest(method, params)
         eff_timeout = timeout if timeout is not None else self.default_timeout
         if deadline_s is not None:
@@ -530,14 +570,19 @@ class JobQueue:
             self._admit_locked(digest)
             self._seq += 1
             jid = f"{digest[:16]}-{self._seq:04d}"
+            # submitted == request arrival, admitted == the instant the
+            # admission gate passed; the worker measures queue wait from
+            # `admitted` (the job only exists as queue work from then on)
             job = Job(id=jid, method=method, params=params, digest=digest,
-                      submitted_at=time.time(), timeout=eff_timeout)
+                      submitted_at=arrival, admitted_at=time.time(),
+                      timeout=eff_timeout)
             self._jobs[jid] = job
             self._by_digest[digest] = jid
         try:
             self._append({"event": "submit", "job_id": jid, "method": method,
                           "params": params, "digest": digest,
-                          "timeout": job.timeout, "ts": job.submitted_at})
+                          "timeout": job.timeout, "ts": job.submitted_at,
+                          "admitted": job.admitted_at})
         except Exception as exc:
             # a dead journal must not wedge the queue: fail the job loudly
             with self._cv:
@@ -638,11 +683,71 @@ class JobQueue:
                 rec["error"] = error
             if job.peak_rss_mb is not None:
                 rec["peak_rss_mb"] = job.peak_rss_mb
+            # journal carries the manifest DIGEST only (O(#jobs), like
+            # the result artifact); replay re-verifies through the store
+            if job.manifest_digest is not None:
+                rec["manifest_digest"] = job.manifest_digest
             self._append(rec)
         except Exception:
             # the in-memory state already transitioned; a journal failure
             # here only costs replay fidelity, never a wedged client
             self.health.incr("journal_write_failures")
+
+    def _write_manifest(self, job: Job, *, trace, compile_events, events,
+                        lru_before, peak_rss_mb, finished,
+                        result_digest=None, error=None) -> str | None:
+        """Build + persist the job's provenance manifest through the
+        artifact store (`<sha256>.manifest.json`); returns the digest.
+
+        IO-tolerant by the metrics.write contract: fault site
+        `manifest.write` fires inside the store write, and ANY failure
+        (broken disk, serialization surprise) counts
+        `manifest_write_failures` and returns None — the job still
+        finishes, its manifest degrades to absent. Only an InjectedCrash
+        propagates (a dead process writes nothing, which is the state
+        replay tests recover from)."""
+        if self.store is None:
+            return None
+        try:
+            man = obs_manifest.build(
+                job_id=job.id, method=job.method,
+                witness_digest=job.digest, attempts=job.attempts,
+                submitted=job.submitted_at, admitted=job.admitted_at,
+                started=job.started_at, finished=finished,
+                queue_wait_s=job.queue_wait_s, trace=trace,
+                compile_events=compile_events, events=events,
+                lru_before=lru_before,
+                lru_after=obs_manifest.lru_snapshot(),
+                peak_rss_mb=peak_rss_mb, result_digest=result_digest,
+                error=None if error is None
+                else f"{error.get('kind')}: {error.get('message')}")
+            return self.store.write(obs_manifest.to_bytes(man),
+                                    suffix=obs_manifest.MANIFEST_SUFFIX,
+                                    fault_site="manifest.write")
+        except faults.InjectedCrash:
+            raise
+        except Exception:
+            self.health.incr("manifest_write_failures")
+            return None
+
+    def manifest(self, job_id: str) -> dict | None:
+        """Load + RE-VERIFY a job's provenance manifest from the artifact
+        store. Returns None when the job has no manifest digest yet (live
+        job, crashed worker, tolerated write failure) or when the stored
+        bytes fail verification (the store quarantines them) — manifests
+        degrade to absent; result-serving rules are unchanged."""
+        with self._cv:
+            job = self._jobs.get(job_id)
+            digest = job.manifest_digest if job is not None else None
+        if digest is None or self.store is None:
+            return None
+        try:
+            return obs_manifest.from_bytes(
+                self.store.read(digest,
+                                suffix=obs_manifest.MANIFEST_SUFFIX))
+        except (ArtifactCorrupt, OSError, ValueError):
+            self.health.incr("manifest_read_failures")
+            return None
 
     def _beat(self, slot: int, jid: str):
         """Heartbeat stamp — called by the worker between prove phases
@@ -674,8 +779,18 @@ class JobQueue:
                 job.started_at = time.time()
                 job.attempts += 1
                 attempt = job.attempts
+                # queue-wait decomposition (ISSUE 8): one float, three
+                # sinks — the job record, the manifest, and the
+                # spectre_queue_wait_seconds histogram observe the SAME
+                # value (tests pin exact parity). Replayed pre-ISSUE-8
+                # journals lack `admitted`; fall back to submit time.
+                job.queue_wait_s = round(
+                    max(0.0, job.started_at
+                        - (job.admitted_at if job.admitted_at is not None
+                           else job.submitted_at)), 6)
                 self._slots[slot]["job"] = jid
                 self._slots[slot]["beat"] = self._clock()
+            obs_metrics.QUEUE_WAIT.observe(job.queue_wait_s)
             try:
                 self._append({"event": "running", "job_id": jid,
                               "attempt": attempt, "ts": job.started_at})
@@ -695,11 +810,21 @@ class JobQueue:
             # profiling.phase below the runner attaches to the trace via
             # the thread-local — no plumbing through prove_* signatures.
             RSS_SAMPLER.start(jid)
+            # provenance capture (ISSUE 8): compile events, degrade/fault
+            # events and table-LRU deltas for the runner's lifetime — all
+            # thread-local, so concurrent workers collect independently
+            lru_before = obs_manifest.lru_snapshot()
+            compile_events: list = []
+            run_events: list = []
+            job_trace = None
             try:
                 if sem is not None:
                     sem.acquire()
                 try:
-                    with obs_tracing.trace(jid):
+                    with obs_tracing.trace(jid) as tr, \
+                            obs_compilelog.capture(compile_events), \
+                            obs_manifest.collect_events(run_events):
+                        job_trace = tr
                         if self._runner_heartbeat:
                             result = self.runner(job.method, job.params,
                                                  heartbeat=heartbeat)
@@ -718,6 +843,13 @@ class JobQueue:
                 raise
             except Exception as exc:
                 peak = RSS_SAMPLER.finish(jid)
+                # failed proves get manifests too — "what degraded before
+                # it died" is exactly what post-mortems need
+                man_digest = self._write_manifest(
+                    job, trace=job_trace, compile_events=compile_events,
+                    events=run_events, lru_before=lru_before,
+                    peak_rss_mb=peak, finished=time.time(),
+                    error=_error_dict(exc))
                 with self._cv:
                     if self._slots[slot]["job"] == jid:
                         self._slots[slot]["job"] = None
@@ -725,6 +857,7 @@ class JobQueue:
                         return      # disowned: replacement took the slot
                     if job.status == "running":
                         job.peak_rss_mb = peak
+                        job.manifest_digest = man_digest
                         self._finish_locked(job, "failed",
                                             error=_error_dict(exc))
                 self.health.incr("jobs_failed")
@@ -747,6 +880,15 @@ class JobQueue:
                     digest = self.store.write(_result_blob(result))
                 except Exception as exc:
                     offload_err = _error_dict(exc)
+            # the provenance manifest is itself an artifact (written
+            # before the terminal journal record so that record can carry
+            # its digest); its sink is IO-tolerant — see _write_manifest
+            man_digest = self._write_manifest(
+                job, trace=job_trace, compile_events=compile_events,
+                events=run_events, lru_before=lru_before,
+                peak_rss_mb=peak, finished=time.time(),
+                result_digest=None if offload_err is not None else digest,
+                error=offload_err)
             with self._cv:
                 if self._slots[slot]["job"] == jid:
                     self._slots[slot]["job"] = None
@@ -761,6 +903,7 @@ class JobQueue:
                 if job.status != "running":
                     continue                    # expired meanwhile: discard
                 job.peak_rss_mb = peak
+                job.manifest_digest = man_digest
                 if offload_err is not None:
                     self._finish_locked(job, "failed", error=offload_err)
                     self.health.incr("jobs_failed")

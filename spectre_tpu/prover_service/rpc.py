@@ -28,6 +28,12 @@ Beyond the reference (PR 3, resilient service):
   exposition (observability/prom.py, counter parity with /healthz);
   `getTrace` returns a completed job's span tree as Chrome trace-event
   JSON (observability/tracing.py).
+* **Provenance (ISSUE 8)** — `getProofManifest` returns a terminal
+  job's provenance manifest (observability/manifest.py), re-verified
+  through the artifact store on every read. A terminal job whose
+  manifest was never written (crash, tolerated sink failure) or fails
+  verification answers `-32006 manifest unavailable` — the RESULT is
+  still served by `getProofResult`; manifests degrade independently.
 """
 
 from __future__ import annotations
@@ -60,6 +66,7 @@ SERVICE_OVERLOADED = -32001     # load shed: carries data.retry_after_s
 JOB_NOT_DONE = -32002
 JOB_NOT_FOUND = -32004
 JOB_FAILED = -32005
+MANIFEST_UNAVAILABLE = -32006   # terminal job, manifest absent/corrupt
 
 
 def _error(code, message, id_=None, data=None):
@@ -276,6 +283,25 @@ class _Handler(BaseHTTPRequestHandler):
             if job.status != "done":
                 return _job_error(job, id_)
             result = job.result
+        elif method == "getProofManifest":
+            jid = params["job_id"]
+            job = self.jobs.result(jid)
+            if job is None:
+                return _error(JOB_NOT_FOUND, f"unknown job {jid}", id_)
+            if job.status in ("queued", "running"):
+                return _error(JOB_NOT_DONE,
+                              f"job {jid} is {job.status}; no manifest "
+                              f"yet", id_)
+            man = self.jobs.manifest(jid)
+            if man is None:
+                # manifests degrade to absent (crashed worker, tolerated
+                # write failure, quarantined corruption) — the result
+                # itself is unaffected and still served
+                return _error(MANIFEST_UNAVAILABLE,
+                              f"manifest for job {jid} unavailable "
+                              f"(never written, or failed verification)",
+                              id_)
+            result = man
         elif method == "cancelProof":
             result = {"cancelled": self.jobs.cancel(params["job_id"])}
         elif method == "getTrace":

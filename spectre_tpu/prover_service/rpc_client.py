@@ -211,6 +211,16 @@ class ProverClient:
         return self._call("getTrace", {"job_id": job_id},
                           timeout=min(self.timeout, 30.0))
 
+    def get_manifest(self, job_id: str) -> dict:
+        """Provenance manifest for a terminal job (ISSUE 8): timestamps
+        with the queue-wait/prove split, resolved modes + env knobs,
+        degrade/fault events, table-LRU deltas, compile events, phase
+        seconds, peak RSS and the result digest. Raises RpcError -32002
+        while the job is live, -32004 for unknown jobs, -32006 when the
+        manifest degraded to absent (the result itself is unaffected)."""
+        return self._call("getProofManifest", {"job_id": job_id},
+                          timeout=min(self.timeout, 30.0))
+
     def metrics_text(self) -> str:
         """Raw GET /metrics body (Prometheus text exposition 0.0.4) from
         the same host as the RPC endpoint."""

@@ -32,6 +32,12 @@ class ProverState:
         Keccak-transcript outer proof), the reference's `*Compressed` RPC
         semantics. Boot additionally creates the two aggregation pkeys from
         dummy app snarks (`cli.rs:241-280`'s dummy-proof-at-setup)."""
+        # compile telemetry (ISSUE 8): register the jax.monitoring
+        # listener BEFORE any jit fires, so pk-creation/boot compiles are
+        # counted too — after boot, a prove whose manifest shows
+        # compile.count == 0 provably hit the jit caches
+        from ..observability import compilelog
+        compilelog.install()
         self.spec = spec
         self.backend = B.get_backend(backend)
         self.concurrency = concurrency

@@ -92,30 +92,35 @@ class ArtifactStore:
         self.health = health
         self._lock = threading.Lock()
 
-    def path_for(self, digest: str) -> str:
-        return os.path.join(self.dir, f"{digest}.bin")
+    def path_for(self, digest: str, suffix: str = ".bin") -> str:
+        # `suffix` namespaces artifact kinds sharing the store: proof
+        # results are `<sha256>.bin`, provenance manifests
+        # `<sha256>.manifest.json` — same digest addressing, same
+        # verification and quarantine rules
+        return os.path.join(self.dir, f"{digest}{suffix}")
 
-    def exists(self, digest: str) -> bool:
-        return os.path.exists(self.path_for(digest))
+    def exists(self, digest: str, suffix: str = ".bin") -> bool:
+        return os.path.exists(self.path_for(digest, suffix))
 
-    def write(self, data: bytes) -> str:
+    def write(self, data: bytes, suffix: str = ".bin",
+              fault_site: str = "artifact.write") -> str:
         """Atomically persist `data`; returns its sha256 hex digest."""
-        faults.check("artifact.write")
+        faults.check(fault_site)
         digest = sha256_hex(data)
         # corrupt-at-write: digest records the INTENDED bytes, the disk
         # gets flipped ones — exactly the rot the read-side check catches
-        data = faults.mangle("artifact.write", data)
-        path = self.path_for(digest)
+        data = faults.mangle(fault_site, data)
+        path = self.path_for(digest, suffix)
         with self._lock:
             if not os.path.exists(path):
                 _atomic_write(path, data)
         return digest
 
-    def read(self, digest: str) -> bytes:
+    def read(self, digest: str, suffix: str = ".bin") -> bytes:
         """Load + verify; a digest mismatch quarantines the file and
         raises :class:`ArtifactCorrupt` instead of serving it."""
         faults.check("artifact.read")
-        path = self.path_for(digest)
+        path = self.path_for(digest, suffix)
         with open(path, "rb") as f:
             data = f.read()
         data = faults.mangle("artifact.read", data)

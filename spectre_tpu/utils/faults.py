@@ -23,6 +23,12 @@ Injection sites threaded through the codebase:
     metrics.write   utils/profiling.py      SPECTRE_METRICS JSONL append
                                             (a broken metrics sink must
                                             never fail a prove)
+    manifest.write  prover_service/jobs.py  provenance-manifest artifact
+                                            write (same tolerance contract
+                                            as metrics.write: the job still
+                                            finishes, `manifest_write_failures`
+                                            counts, the manifest degrades to
+                                            absent)
 
 Kinds and the exception they raise:
 
@@ -136,6 +142,25 @@ class FaultRegistry:
         self._plan: list[list] = []
         self._env_seen: str | None = None
         self.fired: list[tuple[str, str]] = []
+        self._observers: list = []
+
+    def add_observer(self, fn):
+        """Register `fn(site, kind)` to be called (outside the registry
+        lock) every time a fault actually fires. Idempotent per callable;
+        observers must never raise — the provenance-manifest event
+        recorder uses this to stamp injected faults into the job record."""
+        with self._lock:
+            if fn not in self._observers:
+                self._observers.append(fn)
+
+    def _notify(self, site: str, kind: str):
+        with self._lock:
+            observers = list(self._observers)
+        for fn in observers:
+            try:
+                fn(site, kind)
+            except Exception:
+                pass               # observers are best-effort by contract
 
     def install_plan(self, text: str):
         """Replace the active plan (also resets the fired log)."""
@@ -181,6 +206,7 @@ class FaultRegistry:
                     break
             else:
                 return
+        self._notify(site, entry[1])
         raise exc
 
     def mangle(self, site: str, data: bytes) -> bytes:
@@ -198,6 +224,7 @@ class FaultRegistry:
                     break
             else:
                 return data
+        self._notify(site, "corrupt")
         if not data:
             return data
         buf = bytearray(data)
@@ -226,3 +253,4 @@ clear = REGISTRY.clear
 install_plan = REGISTRY.install_plan
 fired_count = REGISTRY.fired_count
 armed = REGISTRY.armed
+add_observer = REGISTRY.add_observer

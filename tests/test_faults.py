@@ -458,6 +458,27 @@ class TestJobQueue:
         assert job.attempts >= 1
         q2.stop()
 
+    def test_manifest_write_fault_never_fails_prove(self, tmp_path):
+        """ISSUE-8 pin: the provenance-manifest sink is IO-tolerant by
+        the metrics.write contract — a broken disk at `manifest.write`
+        costs the manifest (counted), never the prove."""
+        q = self._mk(tmp_path)
+        m0 = HEALTH.get("manifest_write_failures")
+        faults.install_plan("manifest.write:ioerror:1")
+        jid = q.submit("m", {"w": 40})
+        job = q.wait(jid, timeout=10)
+        assert job.status == "done"
+        assert job.result == _digest_runner("m", {"w": 40})
+        assert job.manifest_digest is None
+        assert q.manifest(jid) is None
+        assert HEALTH.get("manifest_write_failures") == m0 + 1
+        # the fault is spent: the next prove manifests normally
+        j2 = q.submit("m", {"w": 41})
+        job2 = q.wait(j2, timeout=10)
+        assert job2.status == "done" and job2.manifest_digest is not None
+        assert q.manifest(j2)["result_digest"] == job2.result_digest
+        q.stop()
+
     def test_journal_lives_under_params_dir(self, tmp_path):
         """ensure_jobs default wiring: the journal lands in the state's
         params_dir, so a service restart over the same dir recovers."""

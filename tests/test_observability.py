@@ -494,6 +494,54 @@ class TestServiceObservabilityHTTP:
             state.jobs.stop()
             server.shutdown()
 
+    def test_queue_wait_and_compile_exposition_parity(self, tmp_path):
+        """ISSUE-8 acceptance: /metrics exposes
+        `spectre_queue_wait_seconds` and `spectre_compile_seconds{fn=}`
+        with EXACT float parity against the manifest-derived values —
+        one rounded float feeds every sink, so equality is ==, not
+        approx. The compile event is driven through the listener
+        directly (same plumbing jax.monitoring calls into)."""
+        from spectre_tpu.observability import compilelog
+        M.QUEUE_WAIT.reset()
+        M.COMPILE_SECONDS.reset()
+
+        def runner(method, params):
+            with prof.phase("prove/quotient"):
+                compilelog._listener(
+                    "/jax/core/compile/backend_compile_duration",
+                    1.23456789)
+            return {"proof": "0xab"}
+
+        server, port, state = self._serve(tmp_path, runner)
+        try:
+            jid = _rpc(port, "submitProof_SyncStepCompressed",
+                       {"w": 1})["result"]["job_id"]
+            assert state.jobs.wait(jid, timeout=10).status == "done"
+            man = _rpc(port, "getProofManifest",
+                       {"job_id": jid})["result"]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=30) as resp:
+                text = resp.read().decode()
+            samples, types_ = _parse_exposition(text)
+
+            assert types_["spectre_queue_wait_seconds"] == "histogram"
+            assert samples["spectre_queue_wait_seconds_count"] == 1
+            assert samples["spectre_queue_wait_seconds_sum"] \
+                == man["queue_wait_s"]
+
+            assert types_["spectre_compile_seconds"] == "histogram"
+            assert man["compile"]["count"] == 1
+            key = 'spectre_compile_seconds_count{fn="prove/quotient"}'
+            assert samples[key] == man["compile"]["count"]
+            key = 'spectre_compile_seconds_sum{fn="prove/quotient"}'
+            assert samples[key] \
+                == man["compile"]["by_fn"]["prove/quotient"]["seconds"] \
+                == 1.234568
+        finally:
+            state.jobs.stop()
+            server.shutdown()
+
     def test_rpc_client_helpers(self, tmp_path):
         from spectre_tpu.prover_service.rpc_client import ProverClient
         server, port, state = self._serve(tmp_path, _ok_runner)
