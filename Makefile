@@ -21,9 +21,11 @@ test: native lint test-faults bench-fast
 # tolerance. PR 8 adds the provenance-manifest tier (test_manifest.py):
 # end-to-end manifest pins, compile telemetry, queue-wait parity,
 # manifest.write fault tolerance, crash-replay without a manifest.
-# Also part of the full pytest ladder above.
+# PR 9 adds the output-integrity tier (test_integrity.py): verify-
+# before-serve SDC matrix, artifact scrubber, readiness self-check,
+# diskfull fault kind. Also part of the full pytest ladder above.
 test-faults: native
-	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py tests/test_service.py tests/test_observability.py tests/test_manifest.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py tests/test_service.py tests/test_observability.py tests/test_manifest.py tests/test_integrity.py -q
 
 test-slow: native
 	RUN_SLOW=1 python -m pytest tests/ -q

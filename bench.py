@@ -400,6 +400,10 @@ def main():
         return
 
     fast = "--fast" in sys.argv[1:]
+    # bench floors gate PROVE/kernel throughput, not the verify-before-
+    # serve overhead (ISSUE 9) — off unless the operator pins it on; the
+    # resolved value is recorded in every metric line
+    os.environ.setdefault("SPECTRE_SELF_VERIFY", "off")
     if fast:
         # CI tier: seconds-scale 2^12 on pinned CPU, regression-gated
         # against the checked-in floors (bench_floor.json)
@@ -475,6 +479,7 @@ def bench_msm(fast: bool) -> bool:
         "msm_mode": result.get("msm_mode", bench_msm_mode()),
         "impl": result.get("impl"),
         "fallback": fallback,
+        "self_verify": os.environ.get("SPECTRE_SELF_VERIFY", "always"),
     }
     if result.get("phase_seconds"):
         # per-phase breakdown from the child's span trace (ISSUE 7) —
@@ -533,6 +538,7 @@ def bench_ntt(fast: bool) -> bool:
         "ntt_mode": result.get("ntt_mode", bench_ntt_mode()),
         "impl": result.get("impl"),
         "fallback": fallback,
+        "self_verify": os.environ.get("SPECTRE_SELF_VERIFY", "always"),
     }
     jl = result.get("jitted_loop_polys_per_s")
     if jl:

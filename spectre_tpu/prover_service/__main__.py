@@ -1,0 +1,6 @@
+"""`python -m spectre_tpu.prover_service <cmd>` — delegates to cli.main
+(the `scrub` subcommand is the usual reason to invoke the module form)."""
+
+from .cli import main
+
+main()

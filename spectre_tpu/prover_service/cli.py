@@ -82,6 +82,17 @@ def main(argv=None):
 
     b = sub.add_parser("bench", help="run the MSM benchmark")
 
+    s = sub.add_parser("scrub", help="offline artifact scrub: re-hash every "
+                       "results/ file against its content address, "
+                       "quarantine rot, expire journal orphans")
+    s.add_argument("--params-dir", required=True,
+                   help="the dir hosting the job journal + results/ store")
+    s.add_argument("--min-age-s", type=float, default=0.0,
+                   help="only expire orphans older than this (default 0: "
+                   "the service is assumed stopped, everything is fair "
+                   "game; the in-service scrubber defaults to "
+                   "$SPECTRE_SCRUB_MIN_AGE_S or 60)")
+
     args = p.parse_args(argv)
     spec = _spec(args.spec)
 
@@ -122,6 +133,30 @@ def main(argv=None):
     elif args.cmd == "bench":
         import subprocess
         subprocess.run([sys.executable, "bench.py"], check=True)
+    elif args.cmd == "scrub":
+        _scrub_cmd(args)
+
+
+def _scrub_cmd(args):
+    """One offline scrubber pass (ISSUE 9): replay the journal to learn
+    which digests are live, then re-hash/quarantine/expire the store."""
+    from ..observability.manifest import MANIFEST_SUFFIX
+    from ..utils.artifacts import ArtifactStore
+    from .jobs import JobJournal
+    from .scrubber import Scrubber
+
+    jobs = JobJournal(args.params_dir).replay()
+    live = set()
+    for job in jobs.values():
+        if job.result_digest is not None:
+            live.add((job.result_digest, ".bin"))
+        if job.manifest_digest is not None:
+            live.add((job.manifest_digest, MANIFEST_SUFFIX))
+    store = ArtifactStore(args.params_dir)
+    summary = Scrubber(store, lambda: live,
+                       min_age_s=args.min_age_s).scrub()
+    summary["live"] = len(live)
+    print(json.dumps(summary))
 
 
 def _circuit_cmd(args, spec):

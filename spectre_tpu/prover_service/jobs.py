@@ -92,6 +92,7 @@ from ..observability.rss import rss_mb  # noqa: F401  (re-export: the
 from ..utils import faults
 from ..utils.artifacts import ArtifactCorrupt, ArtifactStore
 from ..utils.health import HEALTH
+from .scrubber import Scrubber
 
 JOURNAL_NAME = "jobs.journal.jsonl"
 
@@ -342,14 +343,18 @@ class JobQueue:
                  mem_watermark_mb: float | None = None,
                  stall_timeout: float | None = None,
                  clock=time.monotonic, sleep_interval: float | None = None,
-                 latency_hist=None):
+                 latency_hist=None, scrub_interval: float | None = None,
+                 scrub_min_age: float | None = None):
         """`queue_depth`/`mem_watermark_mb`/`stall_timeout` default to the
         SPECTRE_JOB_QUEUE_DEPTH / SPECTRE_MEM_WATERMARK_MB /
         SPECTRE_WORKER_STALL_S env knobs. `clock` and `sleep_interval` are
         the supervisor's injectable time source and scan period (the
         BeaconClient pattern: stall tests run deterministic + fast).
         `latency_hist` (injectable for tests) is the queue-local prove
-        latency histogram that prices `retry_after_s` at its p90."""
+        latency histogram that prices `retry_after_s` at its p90.
+        `scrub_interval`/`scrub_min_age` (ISSUE 9; SPECTRE_SCRUB_INTERVAL_S
+        / SPECTRE_SCRUB_MIN_AGE_S) govern the artifact scrubber — interval
+        0 disables the periodic thread (scrubNow still works)."""
         self.runner = runner
         self.concurrency = max(1, int(concurrency))
         self.semaphore = semaphore
@@ -383,6 +388,11 @@ class JobQueue:
         # does the runner accept a heartbeat callback? (inspected once —
         # plain runner(method, params) callables keep working unchanged)
         self._runner_heartbeat = _accepts_heartbeat(runner)
+        # artifact scrubber (ISSUE 9): built before _recover so the
+        # post-compaction pass can expire freshly-orphaned artifacts
+        self.scrubber = Scrubber(self.store, self._live_artifacts,
+                                 health=health, min_age_s=scrub_min_age) \
+            if self.store is not None else None
         if self.journal is not None:
             self._recover()
         # per-slot worker bookkeeping: the supervisor compares each slot's
@@ -397,6 +407,8 @@ class JobQueue:
             args=(sleep_interval if sleep_interval is not None
                   else max(0.05, min(self.stall_timeout / 4.0, 1.0)),))
         self._supervisor.start()
+        if self.scrubber is not None:
+            self.scrubber.start(scrub_interval, self._stop_event)
 
     @property
     def _workers(self):
@@ -454,6 +466,16 @@ class JobQueue:
                 # a failed compaction costs disk, never correctness: the
                 # original journal is still the source of truth
                 self.health.incr("journal_compact_failures")
+            else:
+                # the scrub pass that follows compaction (ISSUE 9, closes
+                # the PR-8 follow-up): the compacted journal is now the
+                # authority on which digests are live — artifacts it no
+                # longer references are expired, corrupt ones quarantined
+                if self.scrubber is not None:
+                    try:
+                        self.scrubber.scrub()
+                    except Exception:
+                        self.health.incr("artifacts_scrub_errors")
 
     def _resolve_result(self, job: Job):
         """Re-hydrate a done job's result from the artifact store,
@@ -647,9 +669,31 @@ class JobQueue:
 
     def stop(self):
         self._stopped = True
-        self._stop_event.set()
+        self._stop_event.set()     # also stops the scrubber's wait loop
         for _ in range(self.concurrency):
             self._q.put(None)
+
+    # -- artifact scrubbing (ISSUE 9) --------------------------------------
+
+    def _live_artifacts(self) -> set:
+        """(digest, suffix) pairs some known job still references — the
+        scrubber's keep-set. Every status counts: a failed job's partial
+        artifacts are cheap, and expiry must never race a retry."""
+        live = set()
+        with self._cv:
+            for job in self._jobs.values():
+                if job.result_digest is not None:
+                    live.add((job.result_digest, ".bin"))
+                if job.manifest_digest is not None:
+                    live.add((job.manifest_digest,
+                              obs_manifest.MANIFEST_SUFFIX))
+        return live
+
+    def scrub_now(self) -> dict:
+        """One synchronous scrubber pass (the scrubNow RPC / CLI entry)."""
+        if self.scrubber is None:
+            return {"scanned": 0, "corrupt": 0, "expired": 0, "skipped": 0}
+        return self.scrubber.scrub()
 
     # -- worker ------------------------------------------------------------
 

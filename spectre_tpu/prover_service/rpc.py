@@ -34,6 +34,11 @@ Beyond the reference (PR 3, resilient service):
   manifest was never written (crash, tolerated sink failure) or fails
   verification answers `-32006 manifest unavailable` — the RESULT is
   still served by `getProofResult`; manifests degrade independently.
+* **Output integrity (ISSUE 9)** — every prove is verified host-side
+  before its job goes `done` (selfverify.verified_prove; twice-failed
+  proofs surface as `-32005 proof failed self-verification`); the
+  `scrubNow` method runs one artifact-scrubber pass; `GET /healthz`
+  additionally gates readiness on the prove+verify self-check.
 """
 
 from __future__ import annotations
@@ -48,6 +53,7 @@ from ..utils.health import HEALTH
 from ..utils.profiling import phase
 from .calldata import encode_calldata
 from .jobs import ServiceOverloaded, ensure_jobs
+from .selfverify import verified_prove
 from .state import ProverState
 
 RPC_METHOD_STEP = "genEvmProof_SyncStepCompressed"
@@ -76,15 +82,6 @@ def _error(code, message, id_=None, data=None):
     return {"jsonrpc": "2.0", "error": err, "id": id_}
 
 
-def _prove_call(fn, args, heartbeat):
-    """Invoke a prove_* that may or may not accept the worker-supervision
-    heartbeat callback (duck-typed states in tests keep working)."""
-    from .jobs import _accepts_heartbeat
-    if heartbeat is not None and _accepts_heartbeat(fn):
-        return fn(args, heartbeat=heartbeat)
-    return fn(args)
-
-
 def run_proof_method(state, method: str, params: dict,
                      heartbeat=None) -> dict:
     """Prove one request. This is the job-queue runner: everything here runs
@@ -100,7 +97,10 @@ def run_proof_method(state, method: str, params: dict,
                 params["pubkeys"],
                 bytes.fromhex(params["domain"].removeprefix("0x")),
                 spec)
-        proof, instances = _prove_call(state.prove_step, args, heartbeat)
+        # verify-before-serve (ISSUE 9): no proof reaches the journal or
+        # the wire without passing the host-side verifier
+        proof, instances = verified_prove(state, "step", args,
+                                          heartbeat=heartbeat)
         return {
             "proof": "0x" + proof.hex(),
             "instances": [hex(v) for v in instances],
@@ -110,8 +110,8 @@ def run_proof_method(state, method: str, params: dict,
         with phase("job/preprocess"):
             args = rotation_args_from_update(
                 params["light_client_update"], state.spec)
-        proof, instances = _prove_call(state.prove_committee, args,
-                                       heartbeat)
+        proof, instances = verified_prove(state, "committee", args,
+                                          heartbeat=heartbeat)
         # compressed layout: 12 accumulator limbs then app instances,
         # poseidon at [12] (reference: rpc.rs:106 `instances[0][12]`)
         pos_idx = 12 if getattr(state, "compress", False) else 0
@@ -134,6 +134,7 @@ _ERROR_KIND_CODES = {
     "TimeoutError": (JOB_FAILED, "job failed"),
     "StalledWorker": (JOB_FAILED, "job failed"),
     "ArtifactCorrupt": (JOB_FAILED, "result artifact corrupt"),
+    "ProofVerifyFailed": (JOB_FAILED, "proof failed self-verification"),
 }
 
 
@@ -189,7 +190,13 @@ class _Handler(BaseHTTPRequestHandler):
         # breaker means the upstream is considered down — report 503 so
         # orchestrators stop routing, with the counters in the body for
         # the operator. half-open admits a trial request, so it is ready.
-        if any(b["state"] == "open" for b in breakers):
+        # (ISSUE 9) a failing prove+verify self-check gates readiness the
+        # same way: a box that cannot prove correctly never reports ok.
+        sc = getattr(self.state, "self_check", None)
+        if sc is not None:
+            snap["self_check"] = sc.snapshot()
+        if any(b["state"] == "open" for b in breakers) \
+                or (sc is not None and not snap["self_check"]["ok"]):
             snap["status"] = "degraded"
             self._reply(snap, status=503)
             return
@@ -323,11 +330,18 @@ class _Handler(BaseHTTPRequestHandler):
                               f"trace for job {jid} expired from the "
                               f"retention ring", id_)
             result = tracing.chrome_trace(tr)
+        elif method == "scrubNow":
+            # one synchronous artifact-scrubber pass (ISSUE 9): re-hash
+            # every results/ file, quarantine rot, expire orphans
+            result = self.jobs.scrub_now()
         elif method == "health":
             from ..preprocessor.beacon import breaker_snapshot
             result = HEALTH.snapshot()
             result["jobs"] = self.jobs.stats() if self.jobs else {}
             result["beacon_breakers"] = breaker_snapshot()
+            sc = getattr(self.state, "self_check", None)
+            if sc is not None:
+                result["self_check"] = sc.snapshot()
         elif method == "ping":
             result = "pong"
         else:

@@ -77,6 +77,13 @@ class ProverState:
                                              self.k_committee,
                                              default_committee_update_args(spec)),
                 self.backend)
+        # readiness self-check (ISSUE 9): prove+verify a tiny cached
+        # circuit before the box reports ready — GET /healthz stays 503
+        # until it passes, and it re-runs after every SDC retry
+        from .selfverify import SelfCheck
+        self.self_check = SelfCheck()
+        with phase("boot/self_check"):
+            self.self_check.run()
 
     def _dummy_agg_args(self, circuit, pk, k, dummy_args):
         from ..models import AggregationArgs
@@ -118,11 +125,15 @@ class ProverState:
             if pk is not None and all(pk is not a for a in active_pks):
                 pk.release_ext_cache()
 
-    def prove_step(self, args, heartbeat=None) -> tuple[bytes, list]:
+    def prove_step(self, args, heartbeat=None,
+                   backend=None) -> tuple[bytes, list]:
         """`heartbeat` (optional zero-arg callback, threaded in by the job
         queue's worker) is stamped between prove phases so the supervisor
-        can tell a long legitimate prove from a hung worker."""
+        can tell a long legitimate prove from a hung worker. `backend`
+        overrides the boot backend for this one prove — the self-verify
+        SDC retry pins it to CPU (selfverify.verified_prove)."""
         hb = heartbeat or (lambda: None)
+        bk0 = backend if backend is not None else self.backend
         with self.semaphore:
             hb()                     # phase: permit acquired, prove starts
             self._release_idle_ext_caches(self.step_pk,
@@ -133,12 +144,12 @@ class ProverState:
                                                 self.k_step, self.step_agg,
                                                 self.step_agg_pk, args,
                                                 bk=bk, heartbeat=hb),
-                    self.backend)
+                    bk0)
             proof = B.prove_with_fallback(
                 lambda bk: StepCircuit.prove(self.step_pk,
                                              self.srs[self.k_step],
                                              args, self.spec, bk),
-                self.backend)
+                bk0)
             hb()
         return proof, StepCircuit.get_instances(args, self.spec)
 
@@ -160,8 +171,10 @@ class ProverState:
         with ThreadPoolExecutor(max_workers=max(1, self.concurrency)) as ex:
             return list(ex.map(self.prove_committee, args_list))
 
-    def prove_committee(self, args, heartbeat=None) -> tuple[bytes, list]:
+    def prove_committee(self, args, heartbeat=None,
+                        backend=None) -> tuple[bytes, list]:
         hb = heartbeat or (lambda: None)
+        bk0 = backend if backend is not None else self.backend
         with self.semaphore:
             hb()
             self._release_idle_ext_caches(
@@ -174,11 +187,30 @@ class ProverState:
                                                 self.committee_agg,
                                                 self.committee_agg_pk, args,
                                                 bk=bk, heartbeat=hb),
-                    self.backend)
+                    bk0)
             proof = B.prove_with_fallback(
                 lambda bk: CommitteeUpdateCircuit.prove(
                     self.committee_pk, self.srs[self.k_committee], args,
                     self.spec, bk),
-                self.backend)
+                bk0)
             hb()
         return proof, CommitteeUpdateCircuit.get_instances(args, self.spec)
+
+    def verify_proof(self, kind: str, proof: bytes, instances: list) -> bool:
+        """Host-side check of a fresh proof against the matching verifying
+        key — the milliseconds verify-before-serve spends so an SDC'd
+        prove never leaves the box (selfverify.verified_prove). `kind` is
+        "step" or "committee"; `instances` is the flat public-input list
+        the prove returned."""
+        if self.compress:
+            from ..plonk.transcript import KeccakTranscript
+            agg = self.step_agg if kind == "step" else self.committee_agg
+            agg_pk = (self.step_agg_pk if kind == "step"
+                      else self.committee_agg_pk)
+            return bool(agg.verify(agg_pk.vk, self.srs[self.k_agg],
+                                   instances, proof,
+                                   transcript_cls=KeccakTranscript))
+        circuit = StepCircuit if kind == "step" else CommitteeUpdateCircuit
+        pk = self.step_pk if kind == "step" else self.committee_pk
+        k = self.k_step if kind == "step" else self.k_committee
+        return bool(circuit.verify(pk.vk, self.srs[k], instances, proof))

@@ -130,6 +130,20 @@ class ArtifactStore:
             raise ArtifactCorrupt(path, digest, actual)
         return data
 
+    def quarantine_bytes(self, data: bytes, suffix: str = ".bin") -> str:
+        """Persist suspect bytes straight into ``quarantine/`` (named by
+        their own sha256) for forensics — never into the served results
+        namespace. Used by verify-before-serve when a fresh proof fails
+        its host-side check; returns the quarantine digest."""
+        digest = sha256_hex(data)
+        path = os.path.join(self.quarantine_dir, f"{digest}{suffix}")
+        with self._lock:
+            os.makedirs(self.quarantine_dir, exist_ok=True)
+            if not os.path.exists(path):
+                _atomic_write(path, data)
+        self.health.incr("artifacts_quarantined")
+        return digest
+
     def _quarantine(self, path: str):
         """Move a poisoned file aside (never served again, never silently
         destroyed) and count it."""

@@ -29,6 +29,11 @@ Injection sites threaded through the codebase:
                                             finishes, `manifest_write_failures`
                                             counts, the manifest degrades to
                                             absent)
+    proof.bytes     prover_service/selfverify.py  fresh proof bytes between
+                                            prove and verify-before-serve
+                                            (kind ``corrupt``: the silent
+                                            data corruption the self-verify
+                                            layer exists to catch)
 
 Kinds and the exception they raise:
 
@@ -40,6 +45,10 @@ Kinds and the exception they raise:
     timeout     TimeoutError
     connreset   ConnectionResetError
     ioerror     OSError
+    diskfull    OSError(errno.ENOSPC) — a full disk at a write site; the
+                job must fail with a typed error (or degrade best-effort
+                where the write is optional, e.g. manifests), never crash
+                the worker or wedge the queue
     crash       InjectedCrash (BaseException: simulates a hard worker kill —
                 deliberately NOT caught by ``except Exception`` recovery
                 paths, so journal-replay tests exercise a real mid-prove
@@ -64,7 +73,7 @@ import threading
 ENV_VAR = "SPECTRE_FAULT_PLAN"
 
 KINDS = ("raise", "oom", "compile", "http503", "http429", "timeout",
-         "connreset", "ioerror", "crash", "corrupt")
+         "connreset", "ioerror", "diskfull", "crash", "corrupt")
 
 
 class InjectedFault(Exception):
@@ -108,6 +117,9 @@ def _make_exc(site: str, kind: str) -> BaseException:
         return ConnectionResetError(f"injected connection reset at {site}")
     if kind == "ioerror":
         return OSError(f"injected I/O error at {site}")
+    if kind == "diskfull":
+        import errno
+        return OSError(errno.ENOSPC, f"injected ENOSPC (disk full) at {site}")
     raise ValueError(f"unknown fault kind {kind!r} (one of {KINDS})")
 
 

@@ -1019,3 +1019,61 @@ class TestMetricsSinkFault:
         assert len(lines) == 1
         assert lines[0]["phase"] == "sink-test-phase"
         assert lines[0]["seconds"] >= 0
+
+
+class TestDiskFullFault:
+    """ISSUE 9 satellite: a full disk (`diskfull` kind = OSError ENOSPC)
+    at any write site fails the JOB (or just the manifest, per the
+    best-effort contract) with a typed error and a counter — never
+    crashes the worker or wedges the queue."""
+
+    def _mk(self, tmp_path, **kw):
+        from spectre_tpu.prover_service.jobs import JobQueue
+        kw.setdefault("concurrency", 1)
+        return JobQueue(_digest_runner, journal_dir=str(tmp_path), **kw)
+
+    def test_kind_raises_enospc(self):
+        import errno
+        faults.install_plan("d.site:diskfull:1")
+        with pytest.raises(OSError) as e:
+            faults.check("d.site")
+        assert e.value.errno == errno.ENOSPC
+        faults.check("d.site")         # spent: no-op
+
+    def test_artifact_write_diskfull_fails_job_not_queue(self, tmp_path):
+        q = self._mk(tmp_path)
+        faults.install_plan("artifact.write:diskfull:1")
+        jid = q.submit("m", {"w": 90})
+        job = q.wait(jid, timeout=10)
+        assert job.status == "failed"
+        assert job.error["kind"] == "OSError"
+        assert "ENOSPC" in job.error["message"]
+        # queue survives: the next submit proves + persists normally
+        j2 = q.submit("m", {"w": 91})
+        job2 = q.wait(j2, timeout=10)
+        assert job2.status == "done" and job2.result_digest is not None
+        q.stop()
+
+    def test_journal_write_diskfull_fails_job_not_queue(self, tmp_path):
+        q = self._mk(tmp_path)
+        faults.install_plan("journal.write:diskfull:1")
+        jid = q.submit("m", {"w": 92})
+        job = q.wait(jid, timeout=10)
+        assert job.status == "failed"
+        assert job.error["kind"] == "OSError"
+        j2 = q.submit("m", {"w": 93})
+        assert q.wait(j2, timeout=10).status == "done"
+        q.stop()
+
+    def test_manifest_write_diskfull_best_effort(self, tmp_path):
+        # manifests are optional by contract: ENOSPC costs the manifest
+        # (counted on manifest_write_failures), never the prove
+        q = self._mk(tmp_path)
+        m0 = HEALTH.get("manifest_write_failures")
+        faults.install_plan("manifest.write:diskfull:1")
+        jid = q.submit("m", {"w": 94})
+        job = q.wait(jid, timeout=10)
+        assert job.status == "done"
+        assert job.manifest_digest is None and q.manifest(jid) is None
+        assert HEALTH.get("manifest_write_failures") == m0 + 1
+        q.stop()

@@ -557,3 +557,34 @@ class TestServiceObservabilityHTTP:
         finally:
             state.jobs.stop()
             server.shutdown()
+
+
+class TestIntegrityCounters:
+    """ISSUE 9 pin: every output-integrity counter rides the existing
+    ServiceHealth -> /healthz -> /metrics bridge — each appears in the
+    exposition as spectre_<name>_total with exact snapshot parity."""
+
+    COUNTERS = ("proofs_verified", "proofs_verify_failed",
+                "proofs_sdc_retried", "self_check_failures",
+                "artifacts_scrubbed", "artifacts_scrub_corrupt",
+                "artifacts_expired")
+
+    def test_new_counters_render_with_parity(self):
+        h = ServiceHealth()
+        for i, name in enumerate(self.COUNTERS, start=1):
+            h.incr(name, i)
+        text = prom.render(health=h, registry=M.MetricsRegistry())
+        samples, types_ = _parse_exposition(text)
+        snap = h.snapshot()["counters"]
+        for i, name in enumerate(self.COUNTERS, start=1):
+            key = f"spectre_{name}_total"
+            assert samples[key] == i == snap[name], key
+            assert types_[key] == "counter"
+
+    def test_self_verify_phase_in_histogram_vec(self):
+        # the prove/self_verify span cost lands in the same
+        # spectre_phase_seconds{phase=} family every other phase uses
+        from spectre_tpu.observability.metrics import PHASE_SECONDS
+        PHASE_SECONDS.labels(phase="prove/self_verify").observe(0.001)
+        kids = PHASE_SECONDS.children()
+        assert any(k.labels == {"phase": "prove/self_verify"} for k in kids)

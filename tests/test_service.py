@@ -837,3 +837,103 @@ class TestEmittedSpectreSol:
         c = SolSpectre(TINY, 2, 0x1234, Reject(), Reject())
         with pytest.raises(SolRevert, match="step proof invalid"):
             c.step(self._step_input(), b"")
+
+
+class TestOutputIntegrityRPC:
+    """ISSUE 9: the verify-before-serve layer as seen from the wire."""
+
+    def test_healthz_gates_on_self_check(self):
+        """A failing prove+verify self-check turns readiness into a 503
+        with `self_check` in the body; a subsequent passing run restores
+        200. The `health` RPC view carries the same snapshot."""
+        import urllib.error
+
+        from spectre_tpu.prover_service.rpc import serve
+        from spectre_tpu.prover_service.selfverify import SelfCheck
+
+        state = _FakeState(TINY)
+        ok_box = {"ok": False}
+        state.self_check = SelfCheck(runner=lambda: ok_box["ok"])
+        state.self_check.run()
+        server = serve(state, port=0, background=True)
+        port = server.server_address[1]
+        try:
+            req = urllib.request.Request(f"http://127.0.0.1:{port}/healthz")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=60)
+            assert e.value.code == 503
+            body = json.load(e.value)
+            assert body["status"] == "degraded"
+            assert body["self_check"] == {"ok": False, "runs": 1,
+                                          "last_error":
+                                          "tiny-circuit proof failed "
+                                          "verification"}
+            ok_box["ok"] = True
+            state.self_check.run()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=60) as resp:
+                data = json.load(resp)
+            assert data["status"] == "ok"
+            assert data["self_check"]["ok"] is True
+            h = _rpc_post(port, {"jsonrpc": "2.0", "id": 1,
+                                 "method": "health", "params": {}},
+                          timeout=60)["result"]
+            assert h["self_check"]["runs"] == 2
+        finally:
+            server.shutdown()
+
+    def test_proof_verify_failed_sanitized_over_rpc(self):
+        """A twice-failed self-verify surfaces as -32005 with the typed
+        sanitized message — no traceback, no internals."""
+        from spectre_tpu.prover_service.rpc import JOB_FAILED, serve
+        from spectre_tpu.prover_service.selfverify import ProofVerifyFailed
+
+        class _SdcState(_FakeState):
+            def prove_step(self, args):
+                raise ProofVerifyFailed("step")
+
+        server = serve(_SdcState(TINY), port=0, background=True)
+        port = server.server_address[1]
+        try:
+            args = default_sync_step_args(TINY)
+            data = _rpc_post(port, {
+                "jsonrpc": "2.0", "id": 1,
+                "method": "genEvmProof_SyncStepCompressed",
+                "params": _step_request_params(args)}, timeout=120)
+            assert data["error"]["code"] == JOB_FAILED
+            msg = data["error"]["message"]
+            assert msg.startswith("proof failed self-verification")
+            assert "quarantined" in msg
+            assert "Traceback" not in msg and "File \"" not in msg
+        finally:
+            server.shutdown()
+
+    def test_scrub_now_rpc(self, tmp_path):
+        """scrubNow runs one scrubber pass over the queue's store and
+        returns its summary; a hand-corrupted orphan is quarantined."""
+        import os
+
+        from spectre_tpu.prover_service.rpc import serve
+
+        state = _FakeState(TINY)
+        server = serve(state, port=0, background=True,
+                       journal_dir=str(tmp_path), scrub_interval=0)
+        port = server.server_address[1]
+        try:
+            store = state.jobs.store
+            digest = store.write(b"rot me over rpc")
+            path = store.path_for(digest)
+            with open(path, "r+b") as f:
+                f.seek(1)
+                f.write(b"\xee")
+            res = _rpc_post(port, {"jsonrpc": "2.0", "id": 1,
+                                   "method": "scrubNow", "params": {}},
+                            timeout=60)["result"]
+            assert res["corrupt"] == 1
+            assert res["scanned"] == 1
+            assert not os.path.exists(path)
+            assert os.path.exists(os.path.join(
+                store.quarantine_dir, os.path.basename(path)))
+        finally:
+            state.jobs.stop()
+            server.shutdown()
