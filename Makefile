@@ -1,6 +1,6 @@
 # Build orchestration (reference parity: `justfile` recipes).
 
-.PHONY: all native test test-slow test-faults fixtures bench bench-fast setup-committee setup-step lint lint-fast tpu-evidence
+.PHONY: all native test test-slow test-faults fixtures bench bench-fast setup-committee setup-step lint lint-fast tpu-evidence report-ci
 
 all: native
 
@@ -23,9 +23,13 @@ test: native lint test-faults bench-fast
 # manifest.write fault tolerance, crash-replay without a manifest.
 # PR 9 adds the output-integrity tier (test_integrity.py): verify-
 # before-serve SDC matrix, artifact scrubber, readiness self-check,
-# diskfull fault kind. Also part of the full pytest ladder above.
+# diskfull fault kind. PR 10 adds the follower tier (test_follower.py):
+# unbroken update chain across period boundaries, kill-mid-prove
+# byte-identical replay, cache-hit-never-touches-prover, beacon-outage
+# degrade/recover, corrupt-stored-update quarantine + re-prove.
+# Also part of the full pytest ladder above.
 test-faults: native
-	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py tests/test_service.py tests/test_observability.py tests/test_manifest.py tests/test_integrity.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py tests/test_service.py tests/test_observability.py tests/test_manifest.py tests/test_integrity.py tests/test_follower.py -q
 
 test-slow: native
 	RUN_SLOW=1 python -m pytest tests/ -q
@@ -49,6 +53,15 @@ bench: native
 # throughput regression so `make test` surfaces perf rot without the 2^16 run
 bench-fast: native
 	python bench.py --fast
+
+# manifest CI gate (PR 10): diff a candidate provenance manifest against
+# a baseline and exit 3 on a prove_s regression (> 10% by default) or any
+# new backend compile. Point the vars at manifest files or job ids:
+#   make report-ci BASELINE_MANIFEST=base.manifest.json CANDIDATE_MANIFEST=cand.manifest.json
+BASELINE_MANIFEST ?= baseline.manifest.json
+CANDIDATE_MANIFEST ?= candidate.manifest.json
+report-ci:
+	JAX_PLATFORMS=cpu python -m spectre_tpu.observability report $(BASELINE_MANIFEST) --diff $(CANDIDATE_MANIFEST) --ci
 
 # the full hardware-evidence suite, ordered cheap->expensive, every stage
 # deadline-guarded; safe (and labeled) under CPU-JAX when the tunnel is

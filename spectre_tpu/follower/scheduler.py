@@ -1,0 +1,160 @@
+"""Proof scheduler: work items -> JobQueue submissions (ISSUE 10).
+
+Every work item flows through the EXISTING admission-control path
+(:meth:`JobQueue.submit`) — so proactive follower proving gets the crash
+journal, witness-digest dedup, load shedding, worker supervision and the
+verify-before-serve gate for free, and shares one concurrency governor
+with request-driven proving.
+
+Scheduling policy:
+
+* committee-update items always submit before step items (a missed
+  rotation strands the verified update chain; a missed step only delays
+  head freshness — steps backfill);
+* a ``ServiceOverloaded`` shed backs the item off by the server's own
+  ``retry_after_s`` hint (the -32001 contract) instead of hammering;
+* a failed job retries with capped exponential backoff
+  (``follower_jobs_failed`` counts);
+* double submission is impossible by construction — an item already
+  proved is filtered against the update store, an item already in
+  flight keeps its job id, and a resubmission after restart hits the
+  queue's witness-digest dedup.
+
+Completion side: a ``done`` job's result is appended to the
+:class:`~spectre_tpu.follower.updates.UpdateStore` together with its
+job id and provenance-manifest digest (the flight-recorder linkage). A
+store write failure (e.g. injected ENOSPC) counts on
+``follower_store_write_failures`` and retries next cycle — the job
+result is still journaled, nothing is lost.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..prover_service.jobs import ServiceOverloaded
+from ..utils.health import HEALTH
+from ..utils.profiling import phase
+from .tracker import CommitteeUpdateDue
+
+RETRY_BASE_S = 1.0
+RETRY_CAP_S = 60.0
+
+
+class ProofScheduler:
+    def __init__(self, jobs, store, health=HEALTH, clock=time.monotonic,
+                 retry_base_s: float = RETRY_BASE_S,
+                 retry_cap_s: float = RETRY_CAP_S):
+        self.jobs = jobs
+        self.store = store
+        self.health = health
+        self._clock = clock
+        self.retry_base_s = retry_base_s
+        self.retry_cap_s = retry_cap_s
+        # key -> {"item", "jid", "attempts", "not_before"}
+        self._pending: dict[tuple, dict] = {}
+
+    @property
+    def backlog(self) -> int:
+        return len(self._pending)
+
+    def _satisfied(self, item) -> bool:
+        if isinstance(item, CommitteeUpdateDue):
+            return self.store.has_committee(item.period)
+        return self.store.has_step(item.slot)
+
+    def offer(self, items) -> int:
+        """Adopt new work items (idempotent per key). Returns how many
+        were actually new."""
+        fresh = 0
+        for item in items:
+            key = item.key()
+            if key in self._pending or self._satisfied(item):
+                continue
+            self._pending[key] = {"item": item, "jid": None,
+                                  "attempts": 0, "not_before": 0.0}
+            fresh += 1
+        return fresh
+
+    def pump(self) -> dict:
+        """One scheduling cycle: submit every eligible item (committee
+        items first), then collect finished jobs into the store."""
+        summary = {"submitted": 0, "stored": 0, "failed": 0, "shed": 0}
+        now = self._clock()
+        entries = sorted(
+            self._pending.items(),
+            key=lambda kv: (0 if isinstance(kv[1]["item"],
+                                            CommitteeUpdateDue) else 1,
+                            kv[0][1]))
+        for key, ent in entries:
+            if self._pending.get(key) is not ent:
+                continue
+            if ent["jid"] is None:
+                if now < ent["not_before"]:
+                    continue
+                self._submit(ent, summary)
+            if ent["jid"] is not None:
+                self._collect(key, ent, summary, now)
+        return summary
+
+    def _submit(self, ent: dict, summary: dict):
+        item = ent["item"]
+        try:
+            with phase("follower/submit"):
+                ent["jid"] = self.jobs.submit(item.method,
+                                              dict(item.params))
+            self.health.incr("follower_jobs_submitted")
+            summary["submitted"] += 1
+        except ServiceOverloaded as exc:
+            # honor the server's own backoff pricing (-32001 contract)
+            ent["not_before"] = self._clock() + exc.retry_after_s
+            self.health.incr("follower_submits_shed")
+            summary["shed"] += 1
+
+    def _collect(self, key: tuple, ent: dict, summary: dict, now: float):
+        st = self.jobs.status(ent["jid"])
+        if st is None:
+            # queue restarted without this job: resubmit next cycle
+            ent["jid"] = None
+            return
+        if st["status"] in ("queued", "running"):
+            return
+        if st["status"] == "done":
+            job = self.jobs.result(ent["jid"])
+            if job is None or job.result is None:
+                self._backoff(ent, now)
+                self.health.incr("follower_results_unavailable")
+                return
+            try:
+                with phase("follower/store_update"):
+                    self._store(ent["item"], job)
+            except OSError:
+                # diskfull & friends: the job result is still journaled;
+                # retry the append next cycle
+                self.health.incr("follower_store_write_failures")
+                self._backoff(ent, now, keep_job=True)
+                return
+            del self._pending[key]
+            summary["stored"] += 1
+            return
+        # failed / cancelled: capped exponential backoff, then re-prove
+        self._backoff(ent, now)
+        self.health.incr("follower_jobs_failed")
+        summary["failed"] += 1
+
+    def _backoff(self, ent: dict, now: float, keep_job: bool = False):
+        ent["attempts"] += 1
+        if not keep_job:
+            ent["jid"] = None
+        ent["not_before"] = now + min(
+            self.retry_cap_s, self.retry_base_s * 2 ** (ent["attempts"] - 1))
+
+    def _store(self, item, job):
+        manifest_digest = getattr(job, "manifest_digest", None)
+        if isinstance(item, CommitteeUpdateDue):
+            self.store.append_committee(item.period, job.result,
+                                        job_id=job.id,
+                                        manifest_digest=manifest_digest)
+        else:
+            self.store.append_step(item.slot, job.result, job_id=job.id,
+                                   manifest_digest=manifest_digest)

@@ -1,0 +1,283 @@
+"""Verified update store: the follower's durable output (ISSUE 10).
+
+A content-addressed, journal-backed chain of light-client updates:
+``{period -> committee-update proof, slot -> step proof}``. Records ride
+the existing :class:`~spectre_tpu.utils.artifacts.ArtifactStore`
+(``results/<sha256>.update.json``, atomic tmp+fsync+rename, read-side
+re-verification + quarantine) plus an append-only fsync'd JSONL journal
+(``follower.updates.jsonl``, the JobJournal idiom) holding one metadata
+record per stored update.
+
+Integrity contract:
+
+* a record is appended only AFTER the job queue marked the proof
+  ``done`` — and every done proof already passed the verify-before-serve
+  gate (prover_service/selfverify.py), so nothing unverified can enter
+  the chain;
+* each committee record carries its own ``committee_poseidon`` (the
+  chain-linking commitment the compressed circuit exposes at
+  ``instances[12]``) and ``prev_poseidon`` — the predecessor period's
+  commitment — so the stored chain is checkable without re-reading any
+  proof bytes (:meth:`verify_chain`);
+* crash replay re-verifies the chain TIP: the tip artifact is re-read
+  (content-hash checked by the store) and its poseidon cross-checked
+  against the journal record; a corrupt tip is quarantined and dropped
+  so the follower re-proves it instead of serving rot;
+* a record whose artifact fails verification at READ time
+  (:meth:`get_committee` / :meth:`get_step`) is dropped the same way —
+  the tracker sees the period as missing again and the scheduler
+  re-proves it (witness-digest dedup makes that a cheap cache hit when
+  the original job is still journaled).
+
+Fault sites: artifact bytes go through ``artifact.write`` /
+``artifact.read`` (diskfull, corrupt, ...); the journal append is its
+own site ``follower.journal`` so the drills can fill the disk under the
+chain record specifically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from ..utils import faults
+from ..utils.artifacts import ArtifactCorrupt, ArtifactStore
+from ..utils.health import HEALTH
+
+JOURNAL_NAME = "follower.updates.jsonl"
+UPDATE_SUFFIX = ".update.json"
+JOURNAL_FAULT_SITE = "follower.journal"
+
+
+def _canonical(result: dict) -> bytes:
+    return json.dumps(result, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+class UpdateStore:
+    """Thread-safe; one instance per follower, sharing the params dir
+    (and therefore the ``results/`` artifact namespace) with the job
+    queue — register :meth:`live_artifacts` with the queue's scrubber
+    keep-set so stored updates are never expired as orphans."""
+
+    def __init__(self, directory: str, health=HEALTH):
+        os.makedirs(directory, exist_ok=True)
+        self.dir = directory
+        self.health = health
+        self.store = ArtifactStore(directory, health=health)
+        self.path = os.path.join(directory, JOURNAL_NAME)
+        self._lock = threading.RLock()
+        self._committee: dict[int, dict] = {}   # period -> journal record
+        self._steps: dict[int, dict] = {}       # slot -> journal record
+        self._replay()
+
+    # -- journal -----------------------------------------------------------
+
+    def _append(self, record: dict):
+        faults.check(JOURNAL_FAULT_SITE)
+        line = json.dumps(record, sort_keys=True,
+                          separators=(",", ":")) + "\n"
+        with open(self.path, "a") as f:
+            f.write(line)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _replay(self):
+        """Rebuild the maps from the journal (last record per key wins;
+        a torn tail from a crash mid-append is tolerated), then
+        re-verify the chain tip before trusting it."""
+        if not os.path.exists(self.path):
+            return
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break              # torn tail: everything before is good
+                if rec.get("kind") == "committee":
+                    self._committee[int(rec["period"])] = rec
+                elif rec.get("kind") == "step":
+                    self._steps[int(rec["slot"])] = rec
+        if self._committee or self._steps:
+            self.health.incr("follower_journal_replays")
+        self._verify_tip()
+
+    def _verify_tip(self):
+        """Crash-replay integrity: re-read the committee chain tip's
+        artifact and cross-check its poseidon against the journal
+        record; drop (the artifact is already quarantined by the store)
+        anything that fails so the follower re-proves it."""
+        tip = self.tip_period()
+        if tip is None:
+            return
+        rec = self._committee[tip]
+        try:
+            result = json.loads(self.store.read(rec["digest"],
+                                                UPDATE_SUFFIX))
+            ok = result.get("committee_poseidon") == \
+                rec.get("committee_poseidon")
+        except (ArtifactCorrupt, OSError, ValueError):
+            ok = False
+        prev = self._committee.get(tip - 1)
+        if ok and prev is not None:
+            ok = rec.get("prev_poseidon") == prev.get("committee_poseidon")
+        if not ok:
+            del self._committee[tip]
+            self.health.incr("follower_chain_tip_invalid")
+
+    # -- append ------------------------------------------------------------
+
+    def append_committee(self, period: int, result: dict,
+                         job_id: str | None = None,
+                         manifest_digest: str | None = None) -> dict:
+        """Store a done committee-update proof for `period`. The journal
+        record links to the predecessor period's poseidon commitment
+        (None for the trust anchor — the first record of the chain).
+        Raises OSError (e.g. ENOSPC) when the store or journal cannot
+        persist it; the caller retries on the next cycle."""
+        period = int(period)
+        with self._lock:
+            prev = self._committee.get(period - 1)
+            digest = self.store.write(_canonical(result),
+                                      suffix=UPDATE_SUFFIX)
+            rec = {
+                "kind": "committee",
+                "period": period,
+                "digest": digest,
+                "committee_poseidon": result.get("committee_poseidon"),
+                "prev_poseidon": (prev or {}).get("committee_poseidon"),
+                "job_id": job_id,
+                "manifest_digest": manifest_digest,
+                "ts": time.time(),
+            }
+            self._append(rec)
+            self._committee[period] = rec
+        self.health.incr("follower_updates_stored")
+        return rec
+
+    def append_step(self, slot: int, result: dict,
+                    job_id: str | None = None,
+                    manifest_digest: str | None = None) -> dict:
+        slot = int(slot)
+        with self._lock:
+            digest = self.store.write(_canonical(result),
+                                      suffix=UPDATE_SUFFIX)
+            rec = {"kind": "step", "slot": slot, "digest": digest,
+                   "job_id": job_id, "manifest_digest": manifest_digest,
+                   "ts": time.time()}
+            self._append(rec)
+            self._steps[slot] = rec
+        self.health.incr("follower_steps_stored")
+        return rec
+
+    # -- read (serving path: O(artifact read), no prover involved) ---------
+
+    def _load(self, rec: dict) -> dict | None:
+        try:
+            result = json.loads(self.store.read(rec["digest"],
+                                                UPDATE_SUFFIX))
+        except (ArtifactCorrupt, OSError, ValueError):
+            return None
+        out = {k: rec[k] for k in ("kind", "digest", "job_id",
+                                   "manifest_digest") if k in rec}
+        if rec["kind"] == "committee":
+            out["period"] = rec["period"]
+            out["prev_poseidon"] = rec.get("prev_poseidon")
+        else:
+            out["slot"] = rec["slot"]
+        out["result"] = result
+        return out
+
+    def get_committee(self, period: int) -> dict | None:
+        with self._lock:
+            rec = self._committee.get(int(period))
+            if rec is None:
+                return None
+            out = self._load(rec)
+            if out is None:
+                # quarantined by the store's read-side check: drop the
+                # record so the tracker re-emits the period and the
+                # scheduler re-proves it
+                del self._committee[int(period)]
+                self.health.incr("follower_updates_invalidated")
+            return out
+
+    def get_step(self, slot: int) -> dict | None:
+        with self._lock:
+            rec = self._steps.get(int(slot))
+            if rec is None:
+                return None
+            out = self._load(rec)
+            if out is None:
+                del self._steps[int(slot)]
+                self.health.incr("follower_updates_invalidated")
+            return out
+
+    def range_committee(self, start_period: int, count: int):
+        """(found records, missing periods) over [start, start+count)."""
+        updates, missing = [], []
+        for p in range(int(start_period), int(start_period) + int(count)):
+            rec = self.get_committee(p)
+            if rec is None:
+                missing.append(p)
+            else:
+                updates.append(rec)
+        return updates, missing
+
+    # -- chain queries -----------------------------------------------------
+
+    def has_committee(self, period: int) -> bool:
+        with self._lock:
+            return int(period) in self._committee
+
+    def has_step(self, slot: int) -> bool:
+        with self._lock:
+            return int(slot) in self._steps
+
+    def tip_period(self) -> int | None:
+        with self._lock:
+            return max(self._committee) if self._committee else None
+
+    def latest_step_slot(self) -> int | None:
+        with self._lock:
+            return max(self._steps) if self._steps else None
+
+    def verify_chain(self) -> bool:
+        """The stored committee chain is unbroken: contiguous periods,
+        each record's prev_poseidon matching its predecessor's
+        commitment (metadata-only — artifact bytes are verified by the
+        content-addressed store at read time)."""
+        with self._lock:
+            if not self._committee:
+                return True
+            periods = sorted(self._committee)
+            if periods != list(range(periods[0], periods[-1] + 1)):
+                return False
+            for p in periods[1:]:
+                if self._committee[p].get("prev_poseidon") != \
+                        self._committee[p - 1].get("committee_poseidon"):
+                    return False
+            return True
+
+    def live_artifacts(self) -> set:
+        """(digest, suffix) keep-set for the artifact scrubber: stored
+        updates must never be expired as journal orphans."""
+        with self._lock:
+            recs = list(self._committee.values()) + list(self._steps.values())
+        return {(r["digest"], UPDATE_SUFFIX) for r in recs}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "committees": len(self._committee),
+                "steps": len(self._steps),
+                "tip_period": max(self._committee) if self._committee
+                else None,
+                "latest_step_slot": max(self._steps) if self._steps
+                else None,
+            }

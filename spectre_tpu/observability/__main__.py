@@ -12,6 +12,14 @@ Subcommands:
       diff against the second — the triage loop for "why did tonight's
       prove get slower".
 
+  report BASELINE --diff CANDIDATE --ci [--max-prove-regress F]
+                                        [--max-compile-count-increase N]
+      CI gate (ISSUE 10): exits 3 when the CANDIDATE manifest regresses
+      prove_s beyond the fractional threshold (default 0.10 = +10%) or
+      its compile.count grows beyond the allowed increase (default 0 —
+      a new compile in a steady-state path is a cache regression).
+      Wired as `make report-ci`.
+
 Stdlib-only: rendering a manifest must work on a laptop with neither
 jax nor the prover installed beyond this package.
 """
@@ -38,9 +46,37 @@ def _load(target: str, url: str) -> dict:
     return ProverClient(url).get_manifest(target)
 
 
+def _ci_regressions(baseline: dict, candidate: dict,
+                    max_prove_regress: float,
+                    max_compile_count_increase: int) -> list[str]:
+    """The CI gate findings: target = baseline, --diff = candidate."""
+    findings = []
+    base_prove = baseline.get("prove_s")
+    cand_prove = candidate.get("prove_s")
+    if base_prove and cand_prove is not None:
+        allowed = base_prove * (1.0 + max_prove_regress)
+        if cand_prove > allowed:
+            findings.append(
+                f"prove_s regressed: {base_prove:.3f}s -> {cand_prove:.3f}s "
+                f"(+{(cand_prove / base_prove - 1.0) * 100:.1f}%, "
+                f"threshold +{max_prove_regress * 100:.0f}%)")
+    base_cc = (baseline.get("compile") or {}).get("count", 0)
+    cand_cc = (candidate.get("compile") or {}).get("count", 0)
+    if cand_cc > base_cc + max_compile_count_increase:
+        findings.append(
+            f"compile.count regressed: {base_cc} -> {cand_cc} "
+            f"(allowed increase {max_compile_count_increase})")
+    return findings
+
+
 def _cmd_report(args) -> int:
+    if args.ci and args.diff is None:
+        print("--ci requires --diff CANDIDATE (target is the baseline)",
+              file=sys.stderr)
+        return 2
     a = _load(args.target, args.url)
     print(man_mod.render(a))
+    b = None
     if args.diff is not None:
         b = _load(args.diff, args.url)
         print()
@@ -48,6 +84,15 @@ def _cmd_report(args) -> int:
     if args.json:
         print()
         print(json.dumps(a, indent=2, sort_keys=True))
+    if args.ci:
+        findings = _ci_regressions(a, b, args.max_prove_regress,
+                                   args.max_compile_count_increase)
+        print()
+        if findings:
+            for f in findings:
+                print(f"CI REGRESSION: {f}")
+            return 3
+        print("CI gate: ok (no prove_s / compile.count regression)")
     return 0
 
 
@@ -64,6 +109,15 @@ def main(argv=None) -> int:
                         f"(default {DEFAULT_URL})")
     r.add_argument("--json", action="store_true",
                    help="also dump the raw manifest JSON")
+    r.add_argument("--ci", action="store_true",
+                   help="CI gate: exit 3 when --diff (the candidate) "
+                   "regresses prove_s or compile.count beyond thresholds "
+                   "vs the target (the baseline)")
+    r.add_argument("--max-prove-regress", type=float, default=0.10,
+                   help="allowed fractional prove_s increase "
+                   "(default 0.10 = +10%%)")
+    r.add_argument("--max-compile-count-increase", type=int, default=0,
+                   help="allowed compile.count increase (default 0)")
     args = p.parse_args(argv)
     if args.cmd == "report":
         return _cmd_report(args)

@@ -159,6 +159,27 @@ def render(health=None, jobs=None, registry=None) -> str:
             _sample(out, "spectre_beacon_breaker_consecutive_failures",
                     {"base_url": b["base_url"]}, b["consecutive_failures"])
 
+    try:
+        from ..follower.daemon import follower_snapshot
+        followers = follower_snapshot()
+    except Exception:
+        followers = []
+    if followers:
+        for key, help_ in (
+                ("head_lag_slots",
+                 "Slots between newest finalized header and newest "
+                 "stored step proof"),
+                ("periods_behind",
+                 "Sync-committee periods between current period and the "
+                 "verified update chain tip"),
+                ("scheduler_backlog",
+                 "Follower work items pending submit/collect")):
+            mn = f"spectre_follower_{key}"
+            _family(out, mn, "gauge", help_)
+            for f in followers:
+                _sample(out, mn, {"store": f.get("store", "")},
+                        f.get(key, 0))
+
     lru = _lru_stats()
     if lru:
         counter_keys = ("hits", "builds", "evictions", "recomputes")

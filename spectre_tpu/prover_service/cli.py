@@ -76,11 +76,51 @@ def main(argv=None):
                    "disables the file sink — getTrace still serves the "
                    "in-memory ring)")
 
+    f = sub.add_parser("follow", help="run the light-client follower: "
+                       "track the beacon head, prove steps + committee "
+                       "updates, serve verified updates over the RPC API")
+    f.add_argument("--beacon-api", required=True,
+                   help="Beacon REST base URL to follow")
+    f.add_argument("--params-dir", required=True,
+                   help="SRS/pk cache dir; hosts the job journal AND the "
+                   "follower's verified update store "
+                   "(follower.updates.jsonl + results/)")
+    f.add_argument("--host", default="127.0.0.1")
+    f.add_argument("--port", type=int, default=3000)
+    f.add_argument("--poll-s", type=float, default=None,
+                   help="beacon poll cadence (default: "
+                   "$SPECTRE_FOLLOW_POLL_S or 12)")
+    f.add_argument("--backfill", type=int, default=None,
+                   help="max committee-update periods queued per poll "
+                   "(default: $SPECTRE_FOLLOW_BACKFILL or 8)")
+    f.add_argument("--domain", default=None,
+                   help="sync-committee signing domain (hex); step proofs "
+                   "are disabled without it")
+    f.add_argument("--pubkeys-file", default=None,
+                   help="JSON list of compressed pubkey hex strings for "
+                   "the current committee; step proofs are disabled "
+                   "without it")
+    f.add_argument("--k-step", type=int, default=17)
+    f.add_argument("--k-committee", type=int, default=17)
+    f.add_argument("--k-agg", type=int, default=17)
+    f.add_argument("--concurrency", type=int, default=1)
+    f.add_argument("--compress", action="store_true",
+                   help="prove two-stage (aggregated) EVM proofs")
+    f.add_argument("--job-timeout", type=float, default=None)
+    f.add_argument("--queue-depth", type=int, default=None)
+
     u = sub.add_parser("utils", help="deployment utilities")
     u.add_argument("util", choices=["committee-poseidon"])
     u.add_argument("--beacon-api", help="Beacon REST base URL")
 
     b = sub.add_parser("bench", help="run the MSM benchmark")
+
+    fl = sub.add_parser("faults", help="fault-injection site registry")
+    fl.add_argument("--list", action="store_true",
+                    help="print the site table (markdown, the source of "
+                    "the README fault-sites section)")
+    fl.add_argument("--json", action="store_true",
+                    help="machine-readable sites + kinds")
 
     s = sub.add_parser("scrub", help="offline artifact scrub: re-hash every "
                        "results/ file against its content address, "
@@ -133,8 +173,73 @@ def main(argv=None):
     elif args.cmd == "bench":
         import subprocess
         subprocess.run([sys.executable, "bench.py"], check=True)
+    elif args.cmd == "follow":
+        _follow_cmd(args, spec)
+    elif args.cmd == "faults":
+        _faults_cmd(args)
     elif args.cmd == "scrub":
         _scrub_cmd(args)
+
+
+def _follow_cmd(args, spec):
+    """Supervised follower daemon (ISSUE 10): beacon head tracking +
+    proof scheduling in the foreground, the RPC serving API (including
+    getLightClientUpdate) in the background on the same process."""
+    import threading
+
+    from ..follower import Follower
+    from ..observability import compilelog
+    from ..preprocessor.beacon import BeaconClient
+    from .jobs import ensure_jobs
+    from .rpc import serve
+    from .state import ProverState
+
+    compilelog.install()
+    pubkeys = None
+    if args.pubkeys_file:
+        with open(args.pubkeys_file) as fh:
+            pubkeys = json.load(fh)
+    domain = args.domain
+    if not (pubkeys and domain):
+        print("step proofs disabled (need both --pubkeys-file and "
+              "--domain); following committee updates only", flush=True)
+    print(f"loading prover state (spec={spec.name}, "
+          f"backend={args.backend})...", flush=True)
+    state = ProverState(spec, args.k_step, args.k_committee,
+                        args.concurrency, args.backend,
+                        params_dir=args.params_dir,
+                        compress=args.compress, k_agg=args.k_agg)
+    queue_kw = {}
+    if args.queue_depth is not None:
+        queue_kw["queue_depth"] = args.queue_depth
+    jobs = ensure_jobs(state, journal_dir=args.params_dir,
+                       default_timeout=args.job_timeout, **queue_kw)
+    beacon = BeaconClient(args.beacon_api)
+    fol = Follower(spec, beacon, jobs, directory=args.params_dir,
+                   pubkeys=pubkeys, domain=domain, backfill=args.backfill)
+    serve(state, args.host, args.port, background=True,
+          journal_dir=args.params_dir, job_timeout=args.job_timeout,
+          follower=fol, **queue_kw)
+    print(f"following {args.beacon_api}; serving light-client updates "
+          f"on {args.host}:{args.port}", flush=True)
+    stop = threading.Event()
+    try:
+        fol.run(stop, poll_s=args.poll_s)
+    except KeyboardInterrupt:
+        stop.set()
+
+
+def _faults_cmd(args):
+    """Print the fault-site registry (ISSUE 10 satellite): `--list` is
+    the markdown table the README embeds verbatim; `--json` the raw
+    registry for tooling."""
+    from ..utils import faults
+    if args.json:
+        print(json.dumps({"sites": {k: {"module": m, "injects": d}
+                                    for k, (m, d) in faults.SITES.items()},
+                          "kinds": list(faults.KINDS)}, indent=2))
+    else:
+        print(faults.render_site_table())
 
 
 def _scrub_cmd(args):

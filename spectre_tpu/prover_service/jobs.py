@@ -344,7 +344,7 @@ class JobQueue:
                  stall_timeout: float | None = None,
                  clock=time.monotonic, sleep_interval: float | None = None,
                  latency_hist=None, scrub_interval: float | None = None,
-                 scrub_min_age: float | None = None):
+                 scrub_min_age: float | None = None, live_providers=()):
         """`queue_depth`/`mem_watermark_mb`/`stall_timeout` default to the
         SPECTRE_JOB_QUEUE_DEPTH / SPECTRE_MEM_WATERMARK_MB /
         SPECTRE_WORKER_STALL_S env knobs. `clock` and `sleep_interval` are
@@ -388,6 +388,13 @@ class JobQueue:
         # does the runner accept a heartbeat callback? (inspected once —
         # plain runner(method, params) callables keep working unchanged)
         self._runner_heartbeat = _accepts_heartbeat(runner)
+        # external keep-set providers (ISSUE 10): subsystems sharing the
+        # results/ namespace (the follower's update store) contribute
+        # their own (digest, suffix) pairs so neither compaction-time nor
+        # periodic scrubs expire an artifact a chain record references.
+        # Registered BEFORE the scrubber/_recover so the post-compaction
+        # pass already sees them.
+        self._live_providers = list(live_providers)
         # artifact scrubber (ISSUE 9): built before _recover so the
         # post-compaction pass can expire freshly-orphaned artifacts
         self.scrubber = Scrubber(self.store, self._live_artifacts,
@@ -687,7 +694,18 @@ class JobQueue:
                 if job.manifest_digest is not None:
                     live.add((job.manifest_digest,
                               obs_manifest.MANIFEST_SUFFIX))
+        for provider in list(self._live_providers):
+            # a broken provider propagates: the scrub PASS fails (counted
+            # by its caller) rather than running with a partial keep-set
+            # and expiring artifacts that are actually live
+            live |= set(provider())
         return live
+
+    def add_live_provider(self, provider):
+        """Register a zero-arg callable returning extra (digest, suffix)
+        pairs to protect from orphan expiry (idempotent)."""
+        if provider not in self._live_providers:
+            self._live_providers.append(provider)
 
     def scrub_now(self) -> dict:
         """One synchronous scrubber pass (the scrubNow RPC / CLI entry)."""

@@ -39,6 +39,13 @@ Beyond the reference (PR 3, resilient service):
   proofs surface as `-32005 proof failed self-verification`); the
   `scrubNow` method runs one artifact-scrubber pass; `GET /healthz`
   additionally gates readiness on the prove+verify self-check.
+* **Follower serving (ISSUE 10)** — `getLightClientUpdate` (by period
+  or slot), `getUpdateRange` and `followerStatus` serve pre-proved
+  light-client updates out of the follower's verified update store: a
+  cache hit is one content-verified artifact read — it never submits a
+  job, acquires the prover semaphore, or touches the device. A missing
+  or invalidated update answers `-32007 update unavailable` while the
+  follower (re-)proves it in the background.
 """
 
 from __future__ import annotations
@@ -73,6 +80,7 @@ JOB_NOT_DONE = -32002
 JOB_NOT_FOUND = -32004
 JOB_FAILED = -32005
 MANIFEST_UNAVAILABLE = -32006   # terminal job, manifest absent/corrupt
+UPDATE_UNAVAILABLE = -32007     # follower has no verified update (yet)
 
 
 def _error(code, message, id_=None, data=None):
@@ -151,6 +159,7 @@ def _job_error(job, id_):
 class _Handler(BaseHTTPRequestHandler):
     state: ProverState = None  # class attrs injected by serve()
     jobs = None
+    follower = None            # optional: the light-client follower daemon
 
     def log_message(self, fmt, *args):  # quiet by default
         pass
@@ -330,6 +339,39 @@ class _Handler(BaseHTTPRequestHandler):
                               f"trace for job {jid} expired from the "
                               f"retention ring", id_)
             result = tracing.chrome_trace(tr)
+        elif method in ("getLightClientUpdate", "getUpdateRange",
+                        "followerStatus"):
+            # follower serving path (ISSUE 10): pre-proved updates out of
+            # the verified update store — one content-verified artifact
+            # read, never a prover-semaphore acquisition or device touch
+            fol = self.follower
+            if fol is None:
+                return _error(METHOD_NOT_FOUND,
+                              "follower not running (start with "
+                              "`python -m spectre_tpu.prover_service "
+                              "follow`)", id_)
+            if method == "followerStatus":
+                result = fol.snapshot()
+            elif method == "getUpdateRange":
+                count = min(int(params.get("count", 1)), 128)
+                updates, missing = fol.store.range_committee(
+                    int(params["start_period"]), count)
+                result = {"updates": updates, "missing": missing}
+            else:
+                if "period" in params:
+                    rec = fol.store.get_committee(int(params["period"]))
+                    what = f"period {params['period']}"
+                elif "slot" in params:
+                    rec = fol.store.get_step(int(params["slot"]))
+                    what = f"slot {params['slot']}"
+                else:
+                    raise KeyError("period")
+                if rec is None:
+                    return _error(UPDATE_UNAVAILABLE,
+                                  f"no verified update for {what} "
+                                  f"(not yet proved, or invalidated and "
+                                  f"re-proving)", id_)
+                result = rec
         elif method == "scrubNow":
             # one synchronous artifact-scrubber pass (ISSUE 9): re-hash
             # every results/ file, quarantine rot, expire orphans
@@ -351,15 +393,18 @@ class _Handler(BaseHTTPRequestHandler):
 
 def serve(state: ProverState, host: str = "127.0.0.1", port: int = 3000,
           background: bool = False, journal_dir: str | None = None,
-          job_timeout: float | None = None, **queue_kw):
+          job_timeout: float | None = None, follower=None, **queue_kw):
     """`journal_dir` defaults to the state's params_dir (when set) — pass
     explicitly to place the crash-safe job journal elsewhere; `job_timeout`
-    is the default per-job deadline for async submissions. Extra
-    `queue_kw` (queue_depth, mem_watermark_mb, stall_timeout, ...) reach
-    the JobQueue's admission/supervision layer."""
+    is the default per-job deadline for async submissions. `follower`
+    (optional) enables the getLightClientUpdate / getUpdateRange /
+    followerStatus serving methods. Extra `queue_kw` (queue_depth,
+    mem_watermark_mb, stall_timeout, ...) reach the JobQueue's
+    admission/supervision layer."""
     _Handler.state = state
     _Handler.jobs = ensure_jobs(state, journal_dir=journal_dir,
                                 default_timeout=job_timeout, **queue_kw)
+    _Handler.follower = follower
     server = ThreadingHTTPServer((host, port), _Handler)
     if background:
         t = threading.Thread(target=server.serve_forever, daemon=True)

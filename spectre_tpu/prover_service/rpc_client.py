@@ -14,6 +14,12 @@ HTTP 429 with `Retry-After`). Submits and polls honor the server's
 `RpcError` with `.retry_after` set so callers can schedule their own
 retry. `sleep`/`rng` are injectable (the BeaconClient pattern) so the
 backoff paths test deterministically.
+
+ISSUE 10: `wait_for_proof` threads ONE overall deadline (computed once
+from the injectable `clock`) through per-poll HTTP timeouts, overload
+backoffs and poll sleeps, and the follower's stored light-client
+updates are exposed via `get_light_client_update` / `get_update_range`
+/ `follower_status`.
 """
 
 from __future__ import annotations
@@ -55,7 +61,7 @@ class ProverClient:
     def __init__(self, url: str, timeout: float = 3600.0,
                  conn_retries: int = 1, overload_retries: int = 2,
                  retry_after_cap: float = 30.0,
-                 sleep=time.sleep, rng=random.random):
+                 sleep=time.sleep, rng=random.random, clock=time.time):
         self.url = url
         self.timeout = timeout
         self.conn_retries = conn_retries
@@ -63,6 +69,7 @@ class ProverClient:
         self.retry_after_cap = retry_after_cap
         self._sleep = sleep
         self._rng = rng
+        self._clock = clock
         self._id = 0
 
     def _raise_rpc_error(self, data: dict, headers=None):
@@ -113,12 +120,15 @@ class ProverClient:
         return data["result"]
 
     def _call_shedding(self, method: str, params: dict,
-                       timeout: float | None = None):
+                       timeout: float | None = None,
+                       deadline: float | None = None):
         """`_call` plus the ONE bounded overload-retry loop: a -32001/429
         shed sleeps the server's retry_after_s (capped, with jitter so a
         shed fleet doesn't re-stampede) up to `overload_retries` times,
         then surfaces the typed RpcError (with .retry_after) to the
-        caller."""
+        caller. `deadline` (absolute, `clock()` domain) caps the retry
+        sleeps: a backoff that would overshoot it surfaces the RpcError
+        immediately instead — the caller's overall deadline wins."""
         for attempt in range(self.overload_retries + 1):
             try:
                 return self._call(method, params, timeout=timeout)
@@ -127,8 +137,12 @@ class ProverClient:
                         or attempt >= self.overload_retries:
                     raise
                 base = exc.retry_after if exc.retry_after is not None else 1.0
-                delay = min(self.retry_after_cap, base)
-                self._sleep(delay * (1.0 + 0.25 * self._rng()))
+                delay = min(self.retry_after_cap, base) \
+                    * (1.0 + 0.25 * self._rng())
+                if deadline is not None \
+                        and self._clock() + delay > deadline:
+                    raise
+                self._sleep(delay)
 
     def ping(self) -> str:
         return self._call("ping", {}, timeout=min(self.timeout, 30.0))
@@ -186,18 +200,43 @@ class ProverClient:
     def wait_for_proof(self, job_id: str, poll: float = 1.0,
                        timeout: float | None = None) -> dict:
         """Poll getProofStatus until terminal, then return the result.
-        Raises RpcError on a failed job and TimeoutError past `timeout`."""
-        deadline = None if timeout is None else time.time() + timeout
+        Raises RpcError on a failed job and TimeoutError past `timeout`.
+
+        ISSUE 10: ONE overall deadline, computed once from the injected
+        clock, bounds the whole wait — every per-poll HTTP timeout, every
+        overload-retry sleep inside `_call_shedding`, and every poll
+        sleep is clamped to the time remaining, so a slow or shedding
+        server cannot stretch the wait past `timeout`."""
+        deadline = (None if timeout is None
+                    else self._clock() + timeout)
+        last_status = "unknown"
         while True:
-            # polls ride the same bounded overload-retry loop as submits
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    raise TimeoutError(f"job {job_id} still {last_status} "
+                                       f"after {timeout}s")
+            call_timeout = min(self.timeout, 30.0)
+            if remaining is not None:
+                call_timeout = min(call_timeout, max(remaining, 0.1))
+            # polls ride the same bounded overload-retry loop as submits,
+            # but the deadline caps its backoff sleeps too
             st = self._call_shedding("getProofStatus", {"job_id": job_id},
-                                     timeout=min(self.timeout, 30.0))
+                                     timeout=call_timeout, deadline=deadline)
+            last_status = st["status"]
             if st["status"] in ("done", "failed", "cancelled"):
-                return self.proof_result(job_id)
-            if deadline is not None and time.time() > deadline:
-                raise TimeoutError(f"job {job_id} still {st['status']} "
-                                   f"after {timeout}s")
-            self._sleep(poll)
+                result_timeout = min(self.timeout, 30.0)
+                if deadline is not None:
+                    result_timeout = min(
+                        result_timeout,
+                        max(deadline - self._clock(), 0.1))
+                return self._call("getProofResult", {"job_id": job_id},
+                                  timeout=result_timeout)
+            delay = poll
+            if deadline is not None:
+                delay = min(delay, max(deadline - self._clock(), 0.0))
+            self._sleep(delay)
 
     def health(self) -> dict:
         return self._call("health", {}, timeout=min(self.timeout, 30.0))
@@ -219,6 +258,35 @@ class ProverClient:
         while the job is live, -32004 for unknown jobs, -32006 when the
         manifest degraded to absent (the result itself is unaffected)."""
         return self._call("getProofManifest", {"job_id": job_id},
+                          timeout=min(self.timeout, 30.0))
+
+    # -- follower / light-client updates (ISSUE 10) ------------------------
+
+    def get_light_client_update(self, period: int | None = None,
+                                slot: int | None = None) -> dict:
+        """Stored verified update: a committee update by `period` or a
+        step proof by `slot`. Served straight from the follower's update
+        store — a hit never touches the prover. Raises RpcError -32007
+        when the update is not (yet) proved."""
+        params: dict = {}
+        if period is not None:
+            params["period"] = period
+        if slot is not None:
+            params["slot"] = slot
+        return self._call("getLightClientUpdate", params,
+                          timeout=min(self.timeout, 30.0))
+
+    def get_update_range(self, start_period: int, count: int = 1) -> dict:
+        """Contiguous committee updates starting at `start_period`:
+        {"updates": [...], "missing": [periods]} (count capped at 128)."""
+        return self._call("getUpdateRange",
+                          {"start_period": start_period, "count": count},
+                          timeout=min(self.timeout, 30.0))
+
+    def follower_status(self) -> dict:
+        """Follower snapshot: head lag, periods behind, scheduler
+        backlog, chain health (`chain_ok`), stored counts."""
+        return self._call("followerStatus", {},
                           timeout=min(self.timeout, 30.0))
 
     def metrics_text(self) -> str:

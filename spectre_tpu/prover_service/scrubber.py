@@ -25,6 +25,13 @@ the periodic thread (``SPECTRE_SCRUB_INTERVAL_S``, default 300 s, 0
 disables) follows the worker-supervisor discipline: injectable
 clock/interval, exceptions counted (``artifacts_scrub_errors``) and
 never fatal, shutdown via the queue's stop event.
+
+**IO-pressure pacing (ISSUE 10, closes the PR-9 follow-up):** a pass
+re-hashes every byte in ``results/``, so on a box where that takes
+longer than ``SPECTRE_SCRUB_BUDGET_S`` (default 30 s — a proxy for IO
+pressure: a healthy store scans in seconds) the next wait is STRETCHED
+by the overrun ratio (capped at 8x) instead of immediately grinding the
+disk again. Each stretched wait counts on ``scrub_passes_deferred``.
 """
 
 from __future__ import annotations
@@ -40,6 +47,9 @@ INTERVAL_ENV = "SPECTRE_SCRUB_INTERVAL_S"
 INTERVAL_DEFAULT_S = 300.0
 MIN_AGE_ENV = "SPECTRE_SCRUB_MIN_AGE_S"
 MIN_AGE_DEFAULT_S = 60.0
+BUDGET_ENV = "SPECTRE_SCRUB_BUDGET_S"
+BUDGET_DEFAULT_S = 30.0
+MAX_STRETCH = 8.0
 
 _HEX = frozenset("0123456789abcdef")
 _CHUNK = 1 << 20
@@ -77,24 +87,34 @@ class Scrubber:
     everything else that hashes clean is an expirable orphan."""
 
     def __init__(self, store, live_artifacts, health=HEALTH,
-                 min_age_s: float | None = None, clock=time.time):
+                 min_age_s: float | None = None, clock=time.time,
+                 budget_s: float | None = None):
         self.store = store
         self.live_artifacts = live_artifacts
         self.health = health
         self.min_age_s = (min_age_s if min_age_s is not None
                           else _env_float(MIN_AGE_ENV, MIN_AGE_DEFAULT_S))
+        self.budget_s = (budget_s if budget_s is not None
+                         else _env_float(BUDGET_ENV, BUDGET_DEFAULT_S))
+        self.last_pass_s = 0.0
         self._clock = clock
         self._thread: threading.Thread | None = None
 
     def scrub(self) -> dict:
         """One full pass; returns {"scanned","corrupt","expired","skipped"}."""
+        started = self._clock()
+        try:
+            return self._scrub(started)
+        finally:
+            self.last_pass_s = max(0.0, self._clock() - started)
+
+    def _scrub(self, now: float) -> dict:
         summary = {"scanned": 0, "corrupt": 0, "expired": 0, "skipped": 0}
         try:
             names = sorted(os.listdir(self.store.dir))
         except OSError:
             return summary
         live = set(self.live_artifacts())
-        now = self._clock()
         for name in names:
             parsed = parse_name(name)
             path = os.path.join(self.store.dir, name)
@@ -142,9 +162,22 @@ class Scrubber:
         self._thread.start()
         return self._thread
 
+    def next_interval(self, interval_s: float) -> float:
+        """IO-pressure pacing: when the last pass blew its wall-clock
+        budget, stretch the next wait by the overrun ratio (capped at
+        ``MAX_STRETCH``) and count the deferral. A within-budget pass
+        keeps the configured cadence."""
+        if self.budget_s <= 0 or self.last_pass_s <= self.budget_s:
+            return interval_s
+        stretch = min(MAX_STRETCH, self.last_pass_s / self.budget_s)
+        self.health.incr("scrub_passes_deferred")
+        return interval_s * stretch
+
     def _loop(self, interval_s: float, stop_event: threading.Event):
-        while not stop_event.wait(interval_s):
+        wait = interval_s
+        while not stop_event.wait(wait):
             try:
                 self.scrub()
             except Exception:
                 self.health.incr("artifacts_scrub_errors")
+            wait = self.next_interval(interval_s)

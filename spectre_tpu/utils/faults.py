@@ -11,29 +11,11 @@ device OOM at the backend-prove boundary. Each armed entry fires ``count``
 times (in plan order per site) and then disarms; un-named sites are
 zero-cost no-ops.
 
-Injection sites threaded through the codebase:
-
-    beacon.fetch    preprocessor/beacon.py  every REST GET attempt
-    srs.load        plonk/srs.py            SRS file read / setup
-    backend.prove   plonk/backend.py        prove_with_fallback entry
-    journal.write   prover_service/jobs.py  each fsync'd journal append
-    journal.compact prover_service/jobs.py  staged-sidecar swap window
-    artifact.write  utils/artifacts.py      result-file atomic write
-    artifact.read   utils/artifacts.py      result-file read + verify
-    metrics.write   utils/profiling.py      SPECTRE_METRICS JSONL append
-                                            (a broken metrics sink must
-                                            never fail a prove)
-    manifest.write  prover_service/jobs.py  provenance-manifest artifact
-                                            write (same tolerance contract
-                                            as metrics.write: the job still
-                                            finishes, `manifest_write_failures`
-                                            counts, the manifest degrades to
-                                            absent)
-    proof.bytes     prover_service/selfverify.py  fresh proof bytes between
-                                            prove and verify-before-serve
-                                            (kind ``corrupt``: the silent
-                                            data corruption the self-verify
-                                            layer exists to catch)
+Injection sites are registered in :data:`SITES` (site -> (module,
+description)); render the table with ``render_site_table()`` or
+``python -m spectre_tpu.prover_service faults --list``. The README's
+fault-site table is generated from that registry and pinned by a parity
+test — extend SITES when threading a new ``faults.check(...)`` call.
 
 Kinds and the exception they raise:
 
@@ -74,6 +56,48 @@ ENV_VAR = "SPECTRE_FAULT_PLAN"
 
 KINDS = ("raise", "oom", "compile", "http503", "http429", "timeout",
          "connreset", "ioerror", "diskfull", "crash", "corrupt")
+
+# Canonical site registry: site -> (module that calls check()/mangle(),
+# what the fault injects into). The README table and the
+# `prover_service faults --list` CLI are both generated from this dict,
+# so a new site added here shows up everywhere at once.
+SITES = {
+    "beacon.fetch": ("preprocessor/beacon.py",
+                     "every beacon REST GET attempt"),
+    "srs.load": ("plonk/srs.py", "SRS file read / setup"),
+    "backend.prove": ("plonk/backend.py", "prove_with_fallback entry"),
+    "journal.write": ("prover_service/jobs.py",
+                      "each fsync'd job-journal append"),
+    "journal.compact": ("prover_service/jobs.py",
+                        "staged-sidecar swap window"),
+    "artifact.write": ("utils/artifacts.py", "result-file atomic write"),
+    "artifact.read": ("utils/artifacts.py", "result-file read + verify"),
+    "metrics.write": ("utils/profiling.py",
+                      "SPECTRE_METRICS JSONL append (a broken metrics "
+                      "sink must never fail a prove)"),
+    "manifest.write": ("prover_service/jobs.py",
+                       "provenance-manifest artifact write (tolerated: "
+                       "the job still finishes, the manifest degrades "
+                       "to absent)"),
+    "proof.bytes": ("prover_service/selfverify.py",
+                    "fresh proof bytes between prove and "
+                    "verify-before-serve (kind `corrupt` is the silent "
+                    "data corruption the self-verify layer catches)"),
+    "follower.journal": ("follower/updates.py",
+                         "verified-update-store journal append (the "
+                         "follower chain record behind each stored "
+                         "light-client update)"),
+}
+
+
+def render_site_table() -> str:
+    """Markdown table of every registered injection site (the single
+    source the README section and the CLI listing are generated from)."""
+    lines = ["| site | where | injects into |",
+             "|------|-------|--------------|"]
+    for site, (module, desc) in SITES.items():
+        lines.append(f"| `{site}` | `{module}` | {desc} |")
+    return "\n".join(lines)
 
 
 class InjectedFault(Exception):

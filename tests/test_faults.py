@@ -1077,3 +1077,47 @@ class TestDiskFullFault:
         assert job.manifest_digest is None and q.manifest(jid) is None
         assert HEALTH.get("manifest_write_failures") == m0 + 1
         q.stop()
+
+
+class TestFaultSiteDocs:
+    """The README fault-site table is generated, not hand-maintained.
+
+    `python -m spectre_tpu.prover_service faults --list` prints
+    `faults.render_site_table()`; the README embeds that output between
+    `<!-- fault-sites:begin -->` / `<!-- fault-sites:end -->` markers.
+    These pins make drift (a new site without a doc row, or a stale
+    hand-edit) a test failure instead of a silent lie.
+    """
+
+    def _readme_block(self):
+        import pathlib
+
+        readme = pathlib.Path(__file__).resolve().parent.parent / "README.md"
+        text = readme.read_text(encoding="utf-8")
+        begin = "<!-- fault-sites:begin -->"
+        end = "<!-- fault-sites:end -->"
+        assert begin in text and end in text, "README fault-site markers missing"
+        return text.split(begin, 1)[1].split(end, 1)[0].strip()
+
+    def test_readme_table_matches_registry(self):
+        assert self._readme_block() == faults.render_site_table().strip()
+
+    def test_every_site_has_a_table_row(self):
+        block = self._readme_block()
+        for site in faults.SITES:
+            assert f"`{site}`" in block
+
+    def test_cli_faults_list_prints_table(self, capsys):
+        from spectre_tpu.prover_service.cli import main
+
+        assert main(["faults", "--list"]) in (0, None)
+        out = capsys.readouterr().out
+        assert faults.render_site_table().strip() in out
+
+    def test_cli_faults_json_covers_sites_and_kinds(self, capsys):
+        from spectre_tpu.prover_service.cli import main
+
+        assert main(["faults", "--json"]) in (0, None)
+        payload = json.loads(capsys.readouterr().out)
+        assert set(payload["sites"]) == set(faults.SITES)
+        assert tuple(payload["kinds"]) == faults.KINDS

@@ -1,0 +1,552 @@
+"""Follower subsystem drills (ISSUE 10).
+
+A fixture-backed fake beacon synthesizes VALID light-client updates
+(mock-rooted branches + real BLS aggregate signatures, the
+witness/step.py + witness/rotation.py recipe parameterized by slot and
+period) so the follower exercises the real preprocessor verification
+path end to end against a canned-proof state.
+
+Pins the acceptance drills: an unbroken verified update chain across
+period boundaries, kill-mid-prove crash replay resuming the chain with
+byte-identical stored updates, a cache-hit serving path that never
+touches the prover, the beacon-outage degrade/recover loop, plus the
+corrupt-stored-update and diskfull fault drills.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from spectre_tpu import spec as SP
+from spectre_tpu.fields import bls12_381 as bls
+from spectre_tpu.follower import Follower, UpdateStore, follower_snapshot
+from spectre_tpu.follower.scheduler import ProofScheduler
+from spectre_tpu.follower.tracker import HeadTracker
+from spectre_tpu.models import CommitteeUpdateCircuit, StepCircuit
+from spectre_tpu.prover_service.jobs import JobQueue
+from spectre_tpu.prover_service.rpc import run_proof_method
+from spectre_tpu.utils import faults
+from spectre_tpu.utils.health import HEALTH
+from spectre_tpu.witness.rotation import mock_root
+from spectre_tpu.witness.types import (BeaconBlockHeader, CommitteeUpdateArgs,
+                                       SyncStepArgs)
+
+TINY = SP.TINY            # 2 validators, 64 slots per sync period
+STEP_SEED = 1234
+DOMAIN = b"\x07" * 32
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _counter(name: str) -> int:
+    return HEALTH.snapshot()["counters"].get(name, 0)
+
+
+# -- fixture beacon ----------------------------------------------------------
+
+def _hdr_dict(h: BeaconBlockHeader) -> dict:
+    return {"slot": h.slot, "proposer_index": h.proposer_index,
+            "parent_root": "0x" + h.parent_root.hex(),
+            "state_root": "0x" + h.state_root.hex(),
+            "body_root": "0x" + h.body_root.hex()}
+
+
+def _step_sks(spec):
+    return [STEP_SEED * 7919 + i + 1 for i in range(spec.sync_committee_size)]
+
+
+def _step_pubkeys_hex(spec):
+    return ["0x" + bls.g1_compress(bls.sk_to_pk(sk)).hex()
+            for sk in _step_sks(spec)]
+
+
+def _mk_finality_update(spec, fin_slot: int) -> dict:
+    """A valid LightClientFinalityUpdate for `fin_slot`: mock-rooted
+    finality/execution branches, really signed by the deterministic
+    step committee (witness/step.py parameterized by slot)."""
+    sks = _step_sks(spec)
+    finalized = BeaconBlockHeader(
+        slot=fin_slot, proposer_index=3, parent_root=b"\x33" * 32,
+        state_root=b"\x44" * 32, body_root=b"\x00" * 32)
+    exec_root = b"\x55" * 32
+    exec_branch = [bytes([0xA0 + d]) * 32
+                   for d in range(spec.execution_state_root_depth)]
+    finalized.body_root = mock_root(exec_root, exec_branch,
+                                    spec.execution_state_root_index)
+    fin_branch = [bytes([0xB0 + d]) * 32
+                  for d in range(spec.finalized_header_depth)]
+    attested = BeaconBlockHeader(
+        slot=fin_slot + 2, proposer_index=11, parent_root=b"\x66" * 32,
+        state_root=mock_root(finalized.hash_tree_root(), fin_branch,
+                             spec.finalized_header_index),
+        body_root=b"\x77" * 32)
+    args = SyncStepArgs(
+        pubkeys_uncompressed=[(int(x), int(y)) for x, y in
+                              (bls.sk_to_pk(sk) for sk in sks)],
+        participation_bits=[1] * spec.sync_committee_size,
+        attested_header=attested, finalized_header=finalized,
+        finality_branch=fin_branch, execution_payload_root=exec_root,
+        execution_payload_branch=exec_branch, domain=DOMAIN)
+    msg = bls.hash_to_g2(args.signing_root(), spec.dst)
+    sig = bls.aggregate_signatures([bls.g2_curve.mul(msg, sk) for sk in sks])
+    return {
+        "attested_header": _hdr_dict(attested),
+        "finalized_header": _hdr_dict(finalized),
+        "finality_branch": ["0x" + b.hex() for b in fin_branch],
+        "execution_payload_root": "0x" + exec_root.hex(),
+        "execution_branch": ["0x" + b.hex() for b in exec_branch],
+        "sync_aggregate": {
+            "sync_committee_bits": [1] * spec.sync_committee_size,
+            "sync_committee_signature":
+                "0x" + bls.g2_compress(sig).hex(),
+        },
+    }
+
+
+def _mk_committee_update(spec, period: int) -> dict:
+    """A valid committee update for `period` (distinct committee per
+    period — witness/rotation.py parameterized by seed). The branch is
+    built at pubkeys depth so no aggregate-pubkey extension is needed."""
+    seed = 1000 * (period + 1)
+    n = spec.sync_committee_size
+    pks = [bls.sk_to_pk(seed + i + 1) for i in range(n)]
+    pubkeys = [bls.g1_compress(p) for p in pks]
+    args = CommitteeUpdateArgs(pubkeys_compressed=pubkeys)
+    branch = [bytes([(period + d) % 251]) * 32
+              for d in range(spec.sync_committee_pubkeys_depth)]
+    state_root = mock_root(args.committee_pubkeys_root(), branch,
+                           spec.sync_committee_pubkeys_root_index)
+    finalized = BeaconBlockHeader(
+        slot=period * spec.slots_per_period + 1, proposer_index=7,
+        parent_root=b"\x11" * 32, state_root=state_root,
+        body_root=b"\x22" * 32)
+    agg = bls.g1_compress(bls.aggregate_pubkeys(pks)) \
+        if hasattr(bls, "aggregate_pubkeys") else pubkeys[0]
+    return {
+        "finalized_header": _hdr_dict(finalized),
+        "next_sync_committee": {
+            "pubkeys": ["0x" + pk.hex() for pk in pubkeys],
+            "aggregate_pubkey": "0x" + agg.hex(),
+        },
+        "next_sync_committee_branch": ["0x" + b.hex() for b in branch],
+    }
+
+
+class FakeBeacon:
+    """Duck-typed BeaconClient: deterministic valid updates, an
+    `outage` switch for the degrade drill."""
+
+    def __init__(self, spec, fin_slot: int):
+        self.spec = spec
+        self.fin_slot = fin_slot
+        self.outage = False
+        self._fin_cache: dict[int, dict] = {}
+        self._com_cache: dict[int, dict] = {}
+
+    def advance(self, fin_slot: int):
+        self.fin_slot = fin_slot
+
+    def finality_update(self) -> dict:
+        if self.outage:
+            raise OSError("beacon down")
+        if self.fin_slot not in self._fin_cache:
+            self._fin_cache[self.fin_slot] = _mk_finality_update(
+                self.spec, self.fin_slot)
+        return self._fin_cache[self.fin_slot]
+
+    def committee_updates(self, period: int, count: int = 1) -> list:
+        if self.outage:
+            raise OSError("beacon down")
+        if period not in self._com_cache:
+            self._com_cache[period] = _mk_committee_update(self.spec, period)
+        return [self._com_cache[period]]
+
+
+# -- canned-proof state ------------------------------------------------------
+
+class _FollowerState:
+    """Canned prover (proving for real is minutes): real get_instances,
+    fault-checkable at `backend.prove` for the crash drill, counts every
+    prove call so the cache-hit pin can assert the prover was idle."""
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.concurrency = 1
+        self.calls = 0
+
+    def prove_step(self, args):
+        faults.check("backend.prove")
+        self.calls += 1
+        return b"\x01" * 64, StepCircuit.get_instances(args, self.spec)
+
+    def prove_committee(self, args):
+        faults.check("backend.prove")
+        self.calls += 1
+        return (b"\x02" * 64,
+                CommitteeUpdateCircuit.get_instances(args, self.spec))
+
+
+def _mk_queue(state, journal_dir, **kw):
+    runner = lambda method, params, heartbeat=None: \
+        run_proof_method(state, method, params, heartbeat=heartbeat)
+    return JobQueue(runner, concurrency=1, journal_dir=str(journal_dir),
+                    stall_timeout=600.0, **kw)
+
+
+def _drive(follower, predicate, cycles: int = 200, sleep_s: float = 0.02):
+    """run_once until `predicate()` (jobs finish on worker threads)."""
+    for _ in range(cycles):
+        follower.run_once()
+        if predicate():
+            return
+        time.sleep(sleep_s)
+    raise AssertionError("follower did not converge")
+
+
+# -- drills ------------------------------------------------------------------
+
+class TestFollowerChain:
+    def test_unbroken_chain_across_period_boundaries(self, tmp_path):
+        """Acceptance: a beacon advanced across >=2 period boundaries
+        yields an unbroken verified update chain + the head step proof;
+        the lag gauges return to zero."""
+        state = _FollowerState(TINY)
+        jobs = _mk_queue(state, tmp_path)
+        beacon = FakeBeacon(TINY, fin_slot=80)           # period 1
+        fol = Follower(TINY, beacon, jobs, directory=str(tmp_path),
+                       pubkeys=_step_pubkeys_hex(TINY), domain=DOMAIN)
+        try:
+            for fin_slot in (80, 144, 208):              # periods 1, 2, 3
+                beacon.advance(fin_slot)
+                period = TINY.sync_period(fin_slot)
+                _drive(fol, lambda: fol.store.has_committee(period)
+                       and fol.store.has_step(fin_slot))
+            assert fol.store.tip_period() == 3
+            assert sorted(fol.store._committee) == [1, 2, 3]
+            assert fol.store.verify_chain()
+            # linkage: each record carries its predecessor's poseidon
+            for p in (2, 3):
+                rec = fol.store.get_committee(p)
+                prev = fol.store.get_committee(p - 1)
+                assert rec["prev_poseidon"] == \
+                    prev["result"]["committee_poseidon"]
+            assert fol.tracker.head_lag_slots == 0
+            assert fol.tracker.periods_behind == 0
+            assert fol.scheduler.backlog == 0
+            # provenance linkage: stored records point at their job +
+            # manifest (manifest may be None for a journal-less queue,
+            # but the job id is always threaded through)
+            assert fol.store.get_committee(3)["job_id"]
+        finally:
+            jobs.stop()
+
+    def test_crash_mid_prove_replay_resumes_chain_byte_identical(
+            self, tmp_path):
+        """Acceptance: kill mid-prove, journal replay resumes the chain,
+        stored updates byte-identical to an uninterrupted run."""
+        beacon = FakeBeacon(TINY, fin_slot=80)
+
+        # reference: an uninterrupted run in its own directory
+        ref_dir = tmp_path / "ref"
+        state_ref = _FollowerState(TINY)
+        jobs_ref = _mk_queue(state_ref, ref_dir)
+        fol_ref = Follower(TINY, beacon, jobs_ref, directory=str(ref_dir))
+        _drive(fol_ref, lambda: fol_ref.store.has_committee(1))
+        ref_rec = fol_ref.store._committee[1]
+        jobs_ref.stop()
+
+        # crash run: the first prove dies mid-flight (InjectedCrash is a
+        # BaseException — the worker thread is killed, the job stays
+        # `running` in the journal, exactly a SIGKILL's footprint)
+        run_dir = tmp_path / "run"
+        state_a = _FollowerState(TINY)
+        jobs_a = _mk_queue(state_a, run_dir)
+        fol_a = Follower(TINY, beacon, jobs_a, directory=str(run_dir))
+        faults.install_plan("backend.prove:crash:1")
+        fol_a.run_once()                        # poll + submit
+        deadline = time.time() + 5.0
+        while faults.fired_count("backend.prove") < 1:
+            assert time.time() < deadline, "crash fault never fired"
+            time.sleep(0.01)
+        time.sleep(0.05)                        # let the worker die
+        assert not fol_a.store.has_committee(1)
+        jobs_a.stop()
+
+        # restart: replay requeues the running job; a fresh follower on
+        # the same directory re-derives the missing period and the
+        # witness-digest dedup hands it the SAME job
+        state_b = _FollowerState(TINY)
+        jobs_b = _mk_queue(state_b, run_dir)
+        fol_b = Follower(TINY, beacon, jobs_b, directory=str(run_dir))
+        try:
+            _drive(fol_b, lambda: fol_b.store.has_committee(1))
+            assert fol_b.store.verify_chain()
+            rec = fol_b.store._committee[1]
+            # content-addressed: digest equality IS byte equality
+            assert rec["digest"] == ref_rec["digest"]
+            assert rec["committee_poseidon"] == ref_rec["committee_poseidon"]
+        finally:
+            jobs_b.stop()
+
+    def test_restart_replays_journal_and_serves_without_reproving(
+            self, tmp_path):
+        """A restarted UpdateStore replays its journal, re-verifies the
+        chain tip and serves stored updates without any prover involved."""
+        state = _FollowerState(TINY)
+        jobs = _mk_queue(state, tmp_path)
+        beacon = FakeBeacon(TINY, fin_slot=144)
+        fol = Follower(TINY, beacon, jobs, directory=str(tmp_path))
+        _drive(fol, lambda: fol.store.has_committee(2))
+        calls = state.calls
+        jobs.stop()
+
+        store2 = UpdateStore(str(tmp_path))
+        assert store2.tip_period() == 2
+        assert store2.verify_chain()
+        assert store2.get_committee(2)["result"]["committee_poseidon"] \
+            == fol.store._committee[2]["committee_poseidon"]
+        assert state.calls == calls
+
+
+class TestFollowerServing:
+    def test_cache_hit_never_touches_prover(self, tmp_path):
+        """Acceptance pin: getLightClientUpdate for a pre-proved period
+        completes without a prove call or a job submission — one
+        content-verified artifact read."""
+        from spectre_tpu.prover_service.rpc import serve
+
+        state = _FollowerState(TINY)
+        jobs = _mk_queue(state, tmp_path)
+        state.jobs = jobs               # serve() reuses via ensure_jobs
+        store = UpdateStore(str(tmp_path))
+        store.append_committee(5, {"proof": "0x02", "instances": ["0x1"],
+                                   "committee_poseidon": "0xabc"},
+                               job_id="job-5")
+        beacon = FakeBeacon(TINY, fin_slot=5 * TINY.slots_per_period)
+        fol = Follower(TINY, beacon, jobs, store=store)
+        server = serve(state, port=0, background=True, follower=fol)
+        port = server.server_address[1]
+        try:
+            resp = _rpc_post(port, {"jsonrpc": "2.0", "id": 1,
+                                    "method": "getLightClientUpdate",
+                                    "params": {"period": 5}})
+            assert resp["result"]["period"] == 5
+            assert resp["result"]["result"]["committee_poseidon"] == "0xabc"
+            assert state.calls == 0                 # prover never touched
+            assert jobs.stats()["jobs"] == {}       # no job submitted
+
+            rng = _rpc_post(port, {"jsonrpc": "2.0", "id": 2,
+                                   "method": "getUpdateRange",
+                                   "params": {"start_period": 5,
+                                              "count": 3}})
+            assert len(rng["result"]["updates"]) == 1
+            assert rng["result"]["missing"] == [6, 7]
+
+            st = _rpc_post(port, {"jsonrpc": "2.0", "id": 3,
+                                  "method": "followerStatus",
+                                  "params": {}})
+            assert st["result"]["chain_ok"] is True
+            assert st["result"]["tip_period"] == 5
+
+            miss = _rpc_post(port, {"jsonrpc": "2.0", "id": 4,
+                                    "method": "getLightClientUpdate",
+                                    "params": {"period": 9}})
+            assert miss["error"]["code"] == -32007
+            assert state.calls == 0
+        finally:
+            server.shutdown()
+            jobs.stop()
+
+    def test_follower_methods_absent_without_follower(self, tmp_path):
+        from spectre_tpu.prover_service.rpc import serve
+
+        state = _FollowerState(TINY)
+        state.jobs = _mk_queue(state, tmp_path)
+        server = serve(state, port=0, background=True)
+        port = server.server_address[1]
+        try:
+            resp = _rpc_post(port, {"jsonrpc": "2.0", "id": 1,
+                                    "method": "followerStatus",
+                                    "params": {}})
+            assert resp["error"]["code"] == -32601
+        finally:
+            server.shutdown()
+            state.jobs.stop()
+
+
+class TestFollowerFaults:
+    def test_beacon_outage_degrades_then_recovers(self, tmp_path):
+        """Acceptance: outage flips `degraded` + counts beacon errors,
+        in-flight work still pumps; recovery re-derives missed work and
+        head_lag returns to 0."""
+        state = _FollowerState(TINY)
+        jobs = _mk_queue(state, tmp_path)
+        beacon = FakeBeacon(TINY, fin_slot=80)
+        fol = Follower(TINY, beacon, jobs, directory=str(tmp_path),
+                       pubkeys=_step_pubkeys_hex(TINY), domain=DOMAIN)
+        try:
+            _drive(fol, lambda: fol.store.has_step(80))
+            assert fol.tracker.head_lag_slots == 0
+
+            beacon.outage = True
+            beacon.advance(144)
+            before = _counter("follower_beacon_errors")
+            fol.run_once()
+            assert fol.degraded is True
+            assert _counter("follower_beacon_errors") == before + 1
+
+            beacon.outage = False
+            _drive(fol, lambda: fol.store.has_step(144)
+                   and fol.store.has_committee(2))
+            assert fol.degraded is False
+            assert fol.tracker.head_lag_slots == 0
+            assert fol.tracker.periods_behind == 0
+
+            # the lag gauges are exported for every live follower
+            from spectre_tpu.observability import prom
+            text = prom.render()
+            assert "spectre_follower_head_lag_slots" in text
+            assert "spectre_follower_periods_behind" in text
+            assert "spectre_follower_scheduler_backlog" in text
+            assert any(f.get("head_lag_slots") == 0
+                       for f in follower_snapshot())
+        finally:
+            jobs.stop()
+
+    def test_corrupt_stored_update_quarantined_and_reproved(self, tmp_path):
+        """Acceptance drill: rot under a stored update is caught by the
+        content-addressed read, the record is dropped, and the follower
+        re-proves the period."""
+        state = _FollowerState(TINY)
+        jobs = _mk_queue(state, tmp_path)
+        beacon = FakeBeacon(TINY, fin_slot=80)
+        fol = Follower(TINY, beacon, jobs, directory=str(tmp_path))
+        try:
+            _drive(fol, lambda: fol.store.has_committee(1))
+            before = _counter("follower_updates_invalidated")
+            faults.install_plan("artifact.read:corrupt:1")
+            assert fol.store.get_committee(1) is None   # dropped + quarantined
+            assert _counter("follower_updates_invalidated") == before + 1
+            assert not fol.store.has_committee(1)
+
+            _drive(fol, lambda: fol.store.has_committee(1))  # re-proved
+            assert fol.store.get_committee(1)["result"]["committee_poseidon"]
+            assert fol.store.verify_chain()
+        finally:
+            jobs.stop()
+
+    def test_diskfull_on_update_store_retries_next_cycle(self, tmp_path):
+        """Acceptance drill: ENOSPC under the chain journal counts on
+        follower_store_write_failures and the append retries (the job
+        result is still journaled — nothing is lost)."""
+        clk = {"t": 0.0}
+        state = _FollowerState(TINY)
+        jobs = _mk_queue(state, tmp_path)
+        beacon = FakeBeacon(TINY, fin_slot=80)
+        fol = Follower(TINY, beacon, jobs, directory=str(tmp_path),
+                       clock=lambda: clk["t"])
+        try:
+            faults.install_plan("follower.journal:diskfull:1")
+            before = _counter("follower_store_write_failures")
+
+            def _failed_once():
+                return _counter("follower_store_write_failures") == before + 1
+
+            _drive(fol, _failed_once)
+            assert not fol.store.has_committee(1)
+
+            clk["t"] += 120.0          # past the retry backoff
+            _drive(fol, lambda: fol.store.has_committee(1))
+            assert fol.store.verify_chain()
+            assert fol.store.get_committee(1) is not None
+        finally:
+            jobs.stop()
+
+    def test_scheduler_honors_overload_retry_after(self):
+        """A -32001 shed backs the item off by the server's own
+        retry_after_s hint instead of hammering the queue."""
+        from spectre_tpu.prover_service.jobs import ServiceOverloaded
+        from spectre_tpu.follower.tracker import CommitteeUpdateDue
+
+        clk = {"t": 0.0}
+        submitted = []
+
+        class SheddingJobs:
+            def __init__(self):
+                self.shed_left = 2
+
+            def submit(self, method, params):
+                if self.shed_left > 0:
+                    self.shed_left -= 1
+                    raise ServiceOverloaded("queue full", 7.5)
+                submitted.append(method)
+                return "jid-1"
+
+            def status(self, jid):
+                return {"status": "running"}
+
+        class EmptyStore:
+            def has_committee(self, p):
+                return False
+
+            def has_step(self, s):
+                return False
+
+        sched = ProofScheduler(SheddingJobs(), EmptyStore(),
+                               clock=lambda: clk["t"])
+        sched.offer([CommitteeUpdateDue(1, {"light_client_update": {}})])
+        before = _counter("follower_submits_shed")
+        summary = sched.pump()
+        assert summary["shed"] == 1 and not submitted
+        assert _counter("follower_submits_shed") == before + 1
+        sched.pump()                       # still inside the backoff window
+        assert not submitted
+        clk["t"] = 7.6
+        sched.pump()                       # second shed, re-priced backoff
+        assert not submitted
+        clk["t"] = 16.0
+        sched.pump()
+        assert submitted == ["genEvmProof_CommitteeUpdateCompressed"]
+        assert sched.backlog == 1          # in flight until collected
+
+
+class TestTracker:
+    def test_backfill_bounded_per_poll(self, tmp_path):
+        """A tracker far behind queues at most SPECTRE_FOLLOW_BACKFILL
+        committee periods per poll and counts the deferral."""
+        store = UpdateStore(str(tmp_path))
+        beacon = FakeBeacon(TINY, fin_slot=6 * TINY.slots_per_period)
+        tr = HeadTracker(beacon, TINY, store, backfill=2)
+        before = _counter("follower_backfill_deferred")
+        items = tr.poll()
+        assert [i.period for i in items] == [6]  # anchored at first-seen
+        # a store with an old tip is genuinely behind: periods 1..6 due
+        store.append_committee(0, {"committee_poseidon": "0x0"})
+        items = tr.poll()
+        assert [i.period for i in items] == [1, 2]
+        assert _counter("follower_backfill_deferred") == before + 1
+        assert tr.periods_behind == 6
+
+    def test_steps_disabled_without_domain_and_pubkeys(self, tmp_path):
+        store = UpdateStore(str(tmp_path))
+        beacon = FakeBeacon(TINY, fin_slot=80)
+        tr = HeadTracker(beacon, TINY, store)
+        assert not tr.steps_enabled
+        items = tr.poll()
+        assert all(i.key()[0] == "committee" for i in items)
+
+
+def _rpc_post(port, payload, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/rpc", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.load(resp)

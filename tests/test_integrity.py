@@ -429,6 +429,92 @@ class TestScrubber:
         assert out["expired"] == 1 and out["corrupt"] == 0
         assert out["live"] == 0
 
+    def test_compact_then_scrub_keeps_follower_chain_artifacts(
+            self, tmp_path, monkeypatch):
+        """ISSUE 10 satellite, extending the compact-then-scrub matrix:
+        journal compaction + the scrub pass that follows must NEVER
+        expire an artifact the follower's update chain references, even
+        though no JOB journal record mentions it — the UpdateStore's
+        live_artifacts keep-set rides the queue's live-provider hook. A
+        genuine orphan in the same pass is still expired."""
+        from spectre_tpu.follower.updates import UPDATE_SUFFIX, UpdateStore
+
+        q = _mk_queue(tmp_path)
+        jid = q.submit("m", {"w": 20})
+        assert q.wait(jid, timeout=10).status == "done"
+        store = UpdateStore(str(tmp_path))
+        r1 = store.append_committee(1, {"proof": "0x02",
+                                        "committee_poseidon": "0xaa"})
+        r2 = store.append_committee(2, {"proof": "0x03",
+                                        "committee_poseidon": "0xbb"})
+        orphan = q.store.write(b"orphan: nothing references me")
+        q.stop()
+
+        e0 = HEALTH.get("artifacts_expired")
+        # force startup compaction; the post-compaction scrub runs with
+        # the follower keep-set registered (the `follow` CLI wiring)
+        monkeypatch.setenv("SPECTRE_JOURNAL_COMPACT_BYTES", "1")
+        q2 = _mk_queue(tmp_path, scrub_min_age=0,
+                       live_providers=[store.live_artifacts])
+        assert HEALTH.get("artifacts_expired") == e0 + 1   # the orphan only
+        assert not os.path.exists(q2.store.path_for(orphan))
+        for rec in (r1, r2):
+            assert os.path.exists(
+                q2.store.path_for(rec["digest"], UPDATE_SUFFIX))
+        # the chain replays and serves from the surviving artifacts
+        store2 = UpdateStore(str(tmp_path))
+        assert store2.tip_period() == 2
+        assert store2.verify_chain()
+        assert store2.get_committee(1)["result"]["committee_poseidon"] \
+            == "0xaa"
+        # negative control: WITHOUT the provider the same artifacts are
+        # orphans and the scrub reaps them
+        q2.stop()
+        q3 = _mk_queue(tmp_path, scrub_min_age=0)
+        q3.scrub_now()
+        assert not os.path.exists(
+            q3.store.path_for(r1["digest"], UPDATE_SUFFIX))
+        q3.stop()
+
+
+class TestScrubberPacing:
+    def test_overrun_stretches_interval_and_counts(self, tmp_path):
+        """ISSUE 10 satellite: a pass that blew SPECTRE_SCRUB_BUDGET_S
+        stretches the next wait by the overrun ratio (capped) and counts
+        scrub_passes_deferred; a within-budget pass keeps the cadence."""
+        from spectre_tpu.prover_service.scrubber import MAX_STRETCH, Scrubber
+        from spectre_tpu.utils.artifacts import ArtifactStore
+
+        ticks = iter([0.0, 120.0,      # pass 1: 120 s wall clock
+                      200.0, 205.0,    # pass 2: 5 s
+                      300.0, 300.0 + 30.0 * MAX_STRETCH * 4])  # pass 3: huge
+        store = ArtifactStore(str(tmp_path))
+        sc = Scrubber(store, lambda: set(), min_age_s=0, budget_s=30.0,
+                      clock=lambda: next(ticks))
+        d0 = HEALTH.get("scrub_passes_deferred")
+
+        sc.scrub()
+        assert sc.last_pass_s == 120.0
+        assert sc.next_interval(300.0) == pytest.approx(300.0 * 4)  # 120/30
+        assert HEALTH.get("scrub_passes_deferred") == d0 + 1
+
+        sc.scrub()                     # fast pass: cadence restored
+        assert sc.last_pass_s == 5.0
+        assert sc.next_interval(300.0) == 300.0
+        assert HEALTH.get("scrub_passes_deferred") == d0 + 1
+
+        sc.scrub()                     # pathological pass: stretch capped
+        assert sc.next_interval(300.0) == pytest.approx(300.0 * MAX_STRETCH)
+        assert HEALTH.get("scrub_passes_deferred") == d0 + 2
+
+    def test_budget_zero_disables_pacing(self, tmp_path):
+        from spectre_tpu.prover_service.scrubber import Scrubber
+        from spectre_tpu.utils.artifacts import ArtifactStore
+        sc = Scrubber(ArtifactStore(str(tmp_path)), lambda: set(),
+                      min_age_s=0, budget_s=0.0)
+        sc.last_pass_s = 1e9
+        assert sc.next_interval(300.0) == 300.0
+
 
 # ---------------------------------------------------------------------------
 # bench knob (ISSUE 9 small fix)

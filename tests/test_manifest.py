@@ -509,9 +509,11 @@ class TestManifestRpc:
 
 class TestReportCli:
     def _write(self, tmp_path, name, **over):
-        man = manifest.build(job_id=name, method="m",
-                             submitted=1.0, admitted=1.1, started=1.2,
-                             finished=3.2, queue_wait_s=0.1, **over)
+        kw = dict(job_id=name, method="m",
+                  submitted=1.0, admitted=1.1, started=1.2,
+                  finished=3.2, queue_wait_s=0.1)
+        kw.update(over)
+        man = manifest.build(**kw)
         p = tmp_path / f"{name}.manifest.json"
         p.write_bytes(manifest.to_bytes(man))
         return p
@@ -545,6 +547,47 @@ class TestReportCli:
         finally:
             state.jobs.stop()
             server.shutdown()
+
+    def test_ci_gate_passes_within_thresholds(self, tmp_path, capsys):
+        """ISSUE 10 satellite: `report BASELINE --diff CANDIDATE --ci`
+        exits 0 when the candidate stays inside the regression budget."""
+        from spectre_tpu.observability.__main__ import main
+        base = self._write(tmp_path, "base")                 # prove_s 2.0
+        cand = self._write(tmp_path, "cand", finished=3.3)   # +5%
+        assert main(["report", str(base), "--diff", str(cand),
+                     "--ci"]) == 0
+        assert "CI gate: ok" in capsys.readouterr().out
+
+    def test_ci_gate_fails_on_prove_regression(self, tmp_path, capsys):
+        from spectre_tpu.observability.__main__ import main
+        base = self._write(tmp_path, "base")                 # prove_s 2.0
+        cand = self._write(tmp_path, "cand", finished=3.7)   # +25%
+        assert main(["report", str(base), "--diff", str(cand),
+                     "--ci"]) == 3
+        assert "prove_s regressed" in capsys.readouterr().out
+        # a loosened threshold admits the same candidate
+        assert main(["report", str(base), "--diff", str(cand),
+                     "--ci", "--max-prove-regress", "0.5"]) == 0
+
+    def test_ci_gate_fails_on_new_compiles(self, tmp_path, capsys):
+        """A compile on the warm path is a cache regression even when
+        wall time still squeaks under the prove_s threshold."""
+        from spectre_tpu.observability.__main__ import main
+        base = self._write(tmp_path, "base")
+        cand = self._write(
+            tmp_path, "cand",
+            compile_events=[{"event": compilelog.BACKEND_COMPILE,
+                             "fn": "prove", "seconds": 0.5}])
+        assert main(["report", str(base), "--diff", str(cand),
+                     "--ci"]) == 3
+        assert "compile.count regressed" in capsys.readouterr().out
+        assert main(["report", str(base), "--diff", str(cand), "--ci",
+                     "--max-compile-count-increase", "1"]) == 0
+
+    def test_ci_requires_diff(self, tmp_path, capsys):
+        from spectre_tpu.observability.__main__ import main
+        base = self._write(tmp_path, "base")
+        assert main(["report", str(base), "--ci"]) == 2
 
 
 # ---------------------------------------------------------------------------

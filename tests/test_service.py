@@ -536,6 +536,73 @@ class TestProverClient:
         assert len(calls) == 2
 
 
+class TestWaitForProofDeadline:
+    """ISSUE 10 satellite: ONE overall deadline bounds wait_for_proof —
+    slow HTTP round trips, per-poll timeouts and overload-retry sleeps
+    all count against it, so a slow server cannot stretch the wait."""
+
+    def _client(self, clk, **kw):
+        from spectre_tpu.prover_service.rpc_client import ProverClient
+
+        def sleep(s):
+            clk["t"] += s
+        kw.setdefault("rng", lambda: 0.0)   # no jitter: deterministic
+        return ProverClient("http://127.0.0.1:1/rpc", timeout=3600,
+                            sleep=sleep, clock=lambda: clk["t"], **kw)
+
+    def test_slow_polls_cannot_stretch_past_deadline(self):
+        clk = {"t": 0.0}
+        client = self._client(clk)
+        seen_timeouts = []
+
+        def slow_call(method, params, timeout=None):
+            seen_timeouts.append(timeout)
+            clk["t"] += 40.0            # each HTTP round trip eats 40 s
+            return {"status": "running"}
+
+        client._call = slow_call
+        with pytest.raises(TimeoutError, match="still running"):
+            client.wait_for_proof("j1", poll=1.0, timeout=100.0)
+        # polls at t=0/41/82; t=123 > 100 so NO fourth poll starts
+        assert len(seen_timeouts) == 3
+        assert clk["t"] < 130.0
+        # per-call HTTP timeout is clamped to the time remaining
+        assert seen_timeouts[0] == 30.0            # min(3600, 30, 100)
+        assert seen_timeouts[2] == pytest.approx(18.0)   # 100 - 82 left
+
+    def test_overload_backoff_capped_by_deadline(self):
+        from spectre_tpu.prover_service.rpc_client import RpcError
+        clk = {"t": 0.0}
+        client = self._client(clk, retry_after_cap=100.0)
+        calls = []
+
+        def shedding_call(method, params, timeout=None):
+            calls.append(clk["t"])
+            raise RpcError(-32001, "service overloaded", retry_after=50.0)
+
+        client._call = shedding_call
+        with pytest.raises(RpcError) as e:
+            client.wait_for_proof("j1", poll=1.0, timeout=60.0)
+        assert e.value.code == -32001
+        # first shed sleeps its 50 s hint (fits); the second backoff
+        # would land at t=100 > 60 so the error surfaces immediately
+        assert calls == [0.0, 50.0]
+        assert clk["t"] == 50.0                    # never slept past deadline
+
+    def test_no_timeout_waits_indefinitely(self):
+        clk = {"t": 0.0}
+        client = self._client(clk)
+        states = iter(["queued", "running", "done"])
+
+        def call(method, params, timeout=None):
+            if method == "getProofStatus":
+                return {"status": next(states)}
+            return {"proof": "0x01"}
+
+        client._call = call
+        assert client.wait_for_proof("j1", poll=1.0)["proof"] == "0x01"
+
+
 class TestOverloadRPC:
     """ISSUE 6: a shed submission surfaces as HTTP 429 + Retry-After on
     the transport AND `-32001 service overloaded` (with data.retry_after_s)
