@@ -22,7 +22,8 @@ read — it never touches the device.
 from .daemon import Follower, follower_snapshot
 from .scheduler import ProofScheduler
 from .tracker import CommitteeUpdateDue, HeadTracker, StepDue
-from .updates import UpdateStore
+from .updates import ChainOrderError, UpdateStore
 
 __all__ = ["Follower", "follower_snapshot", "ProofScheduler",
-           "HeadTracker", "StepDue", "CommitteeUpdateDue", "UpdateStore"]
+           "HeadTracker", "StepDue", "CommitteeUpdateDue", "UpdateStore",
+           "ChainOrderError"]

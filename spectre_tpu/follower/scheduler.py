@@ -15,6 +15,11 @@ Scheduling policy:
   ``retry_after_s`` hint (the -32001 contract) instead of hammering;
 * a failed job retries with capped exponential backoff
   (``follower_jobs_failed`` counts);
+* committee results land in the store in PERIOD ORDER: a completion
+  whose earlier committee periods are still pending holds its finished
+  job (``follower_chain_waits``) instead of journaling a record with a
+  dangling ``prev_poseidon`` — out-of-order completion can never break
+  the verified chain;
 * double submission is impossible by construction — an item already
   proved is filtered against the update store, an item already in
   flight keeps its job id, and a resubmission after restart hits the
@@ -36,6 +41,7 @@ from ..prover_service.jobs import ServiceOverloaded
 from ..utils.health import HEALTH
 from ..utils.profiling import phase
 from .tracker import CommitteeUpdateDue
+from .updates import ChainOrderError
 
 RETRY_BASE_S = 1.0
 RETRY_CAP_S = 60.0
@@ -89,9 +95,9 @@ class ProofScheduler:
         for key, ent in entries:
             if self._pending.get(key) is not ent:
                 continue
+            if now < ent["not_before"]:
+                continue      # backing off (shed, failure OR store retry)
             if ent["jid"] is None:
-                if now < ent["not_before"]:
-                    continue
                 self._submit(ent, summary)
             if ent["jid"] is not None:
                 self._collect(key, ent, summary, now)
@@ -111,6 +117,22 @@ class ProofScheduler:
             self.health.incr("follower_submits_shed")
             summary["shed"] += 1
 
+    def _chain_blocked(self, item) -> bool:
+        """Committee results must land in the store in period order —
+        a record links to its predecessor's poseidon commitment, so
+        storing period p while an earlier period is still pending would
+        journal a dangling ``prev_poseidon=None`` that nothing heals.
+        Out-of-order completions (a transient failure on p-1, a
+        concurrency>1 queue finishing p first) hold their finished job
+        until every earlier committee period has been stored; within
+        one pump cycle entries are processed in period order, so the
+        successor lands in the same cycle its predecessor does."""
+        if not isinstance(item, CommitteeUpdateDue):
+            return False
+        return any(isinstance(e["item"], CommitteeUpdateDue)
+                   and e["item"].period < item.period
+                   for e in self._pending.values())
+
     def _collect(self, key: tuple, ent: dict, summary: dict, now: float):
         st = self.jobs.status(ent["jid"])
         if st is None:
@@ -120,6 +142,10 @@ class ProofScheduler:
         if st["status"] in ("queued", "running"):
             return
         if st["status"] == "done":
+            if self._chain_blocked(ent["item"]):
+                # keep the finished job; re-checked every cycle
+                self.health.incr("follower_chain_waits")
+                return
             job = self.jobs.result(ent["jid"])
             if job is None or job.result is None:
                 self._backoff(ent, now)
@@ -128,6 +154,12 @@ class ProofScheduler:
             try:
                 with phase("follower/store_update"):
                     self._store(ent["item"], job)
+            except ChainOrderError:
+                # defense in depth: the predecessor is missing from the
+                # store and not pending (e.g. backfill hasn't emitted it
+                # yet) — keep the finished job until it lands
+                self.health.incr("follower_chain_order_rejected")
+                return
             except OSError:
                 # diskfull & friends: the job result is still journaled;
                 # retry the append next cycle

@@ -6,10 +6,12 @@ tests use a fixture-backed fake) for the latest finality update, detects
 sync-committee period boundaries from the spec's epoch math
 (``spec.sync_period``), and emits typed work items:
 
-* :class:`CommitteeUpdateDue` — one per period in the gap between the
-  verified update store's chain tip and the current period (bounded per
-  poll by ``SPECTRE_FOLLOW_BACKFILL``). A missed rotation strands the
-  update chain, so these always sort ahead of steps.
+* :class:`CommitteeUpdateDue` — one per period missing from the
+  verified update store anywhere between the chain anchor and the
+  current period (bounded per poll by ``SPECTRE_FOLLOW_BACKFILL``) —
+  holes below the chain tip (e.g. a quarantined mid-chain record) are
+  re-emitted, not just the gap above the tip. A missed rotation strands
+  the update chain, so these always sort ahead of steps.
 * :class:`StepDue` — the newest finalized header not yet covered by a
   stored step proof.
 
@@ -150,8 +152,14 @@ class HeadTracker:
             self.health.incr("follower_polls")
 
             items: list = []
-            tip = self.store.tip_period()
-            start = tip + 1 if tip is not None else self._first_seen_period
+            # scan from the chain ANCHOR, not the tip: a hole below the
+            # tip (a quarantined mid-chain record, a crash that left
+            # later periods stored) must be re-emitted — starting at
+            # tip+1 would shadow it forever while verify_chain() stays
+            # false with nothing re-proving the gap
+            anchor = self.store.anchor_period()
+            start = (self._first_seen_period if anchor is None
+                     else min(anchor, self._first_seen_period))
             missing = [p for p in range(start, period + 1)
                        if not self.store.has_committee(p)]
             for p in missing[:self.backfill]:

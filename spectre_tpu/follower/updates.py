@@ -51,6 +51,13 @@ UPDATE_SUFFIX = ".update.json"
 JOURNAL_FAULT_SITE = "follower.journal"
 
 
+class ChainOrderError(RuntimeError):
+    """Appending this committee record would break the chain: its
+    predecessor period is not stored (and it is not the trust anchor),
+    so the prev_poseidon link cannot be recorded. The caller must store
+    the predecessor first (the scheduler gates collection on this)."""
+
+
 def _canonical(result: dict) -> bytes:
     return json.dumps(result, sort_keys=True,
                       separators=(",", ":")).encode()
@@ -71,6 +78,11 @@ class UpdateStore:
         self._lock = threading.RLock()
         self._committee: dict[int, dict] = {}   # period -> journal record
         self._steps: dict[int, dict] = {}       # slot -> journal record
+        # lowest committee period ever journaled — the chain's trust
+        # anchor. Survives in-memory invalidations (a dropped record is
+        # re-proved, not forgotten) so the tracker can re-derive holes
+        # anywhere in [anchor, head], not just above the tip.
+        self._anchor: int | None = None
         self._replay()
 
     # -- journal -----------------------------------------------------------
@@ -87,22 +99,33 @@ class UpdateStore:
     def _replay(self):
         """Rebuild the maps from the journal (last record per key wins;
         a torn tail from a crash mid-append is tolerated), then
-        re-verify the chain tip before trusting it."""
+        re-verify the chain tip before trusting it. Only the LAST line
+        may be torn — an unparseable line mid-file is bit rot, not a
+        crash footprint, so it is skipped and counted
+        (``follower_journal_corrupt_lines``) instead of silently
+        discarding every valid record after it."""
         if not os.path.exists(self.path):
             return
         with open(self.path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    break              # torn tail: everything before is good
-                if rec.get("kind") == "committee":
-                    self._committee[int(rec["period"])] = rec
-                elif rec.get("kind") == "step":
-                    self._steps[int(rec["slot"])] = rec
+            lines = f.read().splitlines()
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                if i == len(lines) - 1:
+                    break          # torn tail: everything before is good
+                self.health.incr("follower_journal_corrupt_lines")
+                continue
+            if rec.get("kind") == "committee":
+                period = int(rec["period"])
+                self._committee[period] = rec
+                if self._anchor is None or period < self._anchor:
+                    self._anchor = period
+            elif rec.get("kind") == "step":
+                self._steps[int(rec["slot"])] = rec
         if self._committee or self._steps:
             self.health.incr("follower_journal_replays")
         self._verify_tip()
@@ -139,10 +162,26 @@ class UpdateStore:
         record links to the predecessor period's poseidon commitment
         (None for the trust anchor — the first record of the chain).
         Raises OSError (e.g. ENOSPC) when the store or journal cannot
-        persist it; the caller retries on the next cycle."""
+        persist it (the caller retries on the next cycle) and
+        :class:`ChainOrderError` when the append would record a broken
+        link: appends must land in period order, so a record whose
+        predecessor is neither stored nor the trust anchor is refused
+        instead of being written with ``prev_poseidon=None`` — an
+        out-of-order completion must wait for its predecessor."""
         period = int(period)
         with self._lock:
             prev = self._committee.get(period - 1)
+            if prev is None and self._committee and period != self._anchor:
+                # no predecessor and not the trust anchor being
+                # re-proved after invalidation: recording this now would
+                # journal a dangling prev_poseidon=None link that a
+                # later predecessor append could never heal — the
+                # out-of-order completion must wait (the scheduler
+                # gates collection on this)
+                raise ChainOrderError(
+                    f"committee period {period} out of order: period "
+                    f"{period - 1} is not stored and {period} is not the "
+                    f"chain anchor ({self._anchor})")
             digest = self.store.write(_canonical(result),
                                       suffix=UPDATE_SUFFIX)
             rec = {
@@ -157,6 +196,8 @@ class UpdateStore:
             }
             self._append(rec)
             self._committee[period] = rec
+            if self._anchor is None or period < self._anchor:
+                self._anchor = period
         self.health.incr("follower_updates_stored")
         return rec
 
@@ -242,6 +283,19 @@ class UpdateStore:
     def tip_period(self) -> int | None:
         with self._lock:
             return max(self._committee) if self._committee else None
+
+    def anchor_period(self) -> int | None:
+        """The chain's trust anchor: the lowest committee period ever
+        journaled. Unlike :meth:`tip_period` this does NOT move when a
+        record is invalidated at read time, so the tracker can derive
+        missing work over the whole [anchor, head] span — a hole below
+        the tip (a quarantined mid-chain record, a crash between
+        out-of-order completions) is re-emitted instead of being
+        shadowed by the tip."""
+        with self._lock:
+            if self._anchor is not None:
+                return self._anchor
+            return min(self._committee) if self._committee else None
 
     def latest_step_slot(self) -> int | None:
         with self._lock:

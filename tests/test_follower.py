@@ -22,9 +22,10 @@ import pytest
 
 from spectre_tpu import spec as SP
 from spectre_tpu.fields import bls12_381 as bls
-from spectre_tpu.follower import Follower, UpdateStore, follower_snapshot
+from spectre_tpu.follower import (ChainOrderError, Follower, UpdateStore,
+                                  follower_snapshot)
 from spectre_tpu.follower.scheduler import ProofScheduler
-from spectre_tpu.follower.tracker import HeadTracker
+from spectre_tpu.follower.tracker import CommitteeUpdateDue, HeadTracker
 from spectre_tpu.models import CommitteeUpdateCircuit, StepCircuit
 from spectre_tpu.prover_service.jobs import JobQueue
 from spectre_tpu.prover_service.rpc import run_proof_method
@@ -209,6 +210,39 @@ def _drive(follower, predicate, cycles: int = 200, sleep_s: float = 0.02):
             return
         time.sleep(sleep_s)
     raise AssertionError("follower did not converge")
+
+
+class _ScriptedJob:
+    def __init__(self, jid, result):
+        self.id = jid
+        self.result = result
+        self.manifest_digest = None
+
+
+class ScriptedJobs:
+    """Duck-typed JobQueue whose completions the test scripts by hand —
+    the only way to pin out-of-order completion deterministically."""
+
+    def __init__(self):
+        self._status: dict[str, str] = {}
+        self._results: dict[str, _ScriptedJob] = {}
+        self._n = 0
+
+    def submit(self, method, params) -> str:
+        self._n += 1
+        jid = f"j{self._n}"
+        self._status[jid] = "running"
+        return jid
+
+    def status(self, jid):
+        return {"status": self._status[jid]}
+
+    def result(self, jid):
+        return self._results.get(jid)
+
+    def finish(self, jid, result: dict):
+        self._status[jid] = "done"
+        self._results[jid] = _ScriptedJob(jid, result)
 
 
 # -- drills ------------------------------------------------------------------
@@ -516,6 +550,126 @@ class TestFollowerFaults:
         sched.pump()
         assert submitted == ["genEvmProof_CommitteeUpdateCompressed"]
         assert sched.backlog == 1          # in flight until collected
+
+
+class TestChainOrder:
+    """Out-of-order completion must never break the committee chain
+    (REVIEW: a backfill whose period-5 job failed transiently while 6
+    finished first used to journal 6 with prev_poseidon=None — and
+    nothing ever healed it)."""
+
+    def test_out_of_order_completion_holds_until_predecessor_stored(
+            self, tmp_path):
+        jobs = ScriptedJobs()
+        store = UpdateStore(str(tmp_path))
+        sched = ProofScheduler(jobs, store, clock=lambda: 0.0)
+        sched.offer([
+            CommitteeUpdateDue(5, {"light_client_update": {"p": 5}}),
+            CommitteeUpdateDue(6, {"light_client_update": {"p": 6}}),
+        ])
+        sched.pump()                    # j1 <- period 5, j2 <- period 6
+        before = _counter("follower_chain_waits")
+        jobs.finish("j2", {"committee_poseidon": "0xb"})    # 6 lands first
+        sched.pump()
+        assert not store.has_committee(6)       # held, NOT stored with a
+        assert store.verify_chain()             # dangling None link
+        assert _counter("follower_chain_waits") == before + 1
+        jobs.finish("j1", {"committee_poseidon": "0xa"})
+        summary = sched.pump()          # period order: 5 lands, then 6
+        assert summary["stored"] == 2
+        assert store._committee[6]["prev_poseidon"] == "0xa"
+        assert store.verify_chain()
+        assert sched.backlog == 0
+
+    def test_append_committee_rejects_gap_allows_anchor_reprove(
+            self, tmp_path):
+        store = UpdateStore(str(tmp_path))
+        store.append_committee(3, {"committee_poseidon": "0xa"})
+        with pytest.raises(ChainOrderError):
+            store.append_committee(5, {"committee_poseidon": "0xc"})
+        store.append_committee(4, {"committee_poseidon": "0xb"})
+        store.append_committee(5, {"committee_poseidon": "0xc"})
+        assert store.verify_chain()
+        # the trust anchor may legitimately be re-appended with no
+        # predecessor after a read-time invalidation
+        faults.install_plan("artifact.read:corrupt:1")
+        assert store.get_committee(3) is None
+        assert store.anchor_period() == 3       # the anchor never moves
+        store.append_committee(3, {"committee_poseidon": "0xa"})
+        assert sorted(store._committee) == [3, 4, 5]
+        assert store.verify_chain()
+
+    def test_hole_below_tip_reemitted_by_tracker(self, tmp_path):
+        """REVIEW: missing periods derive from the chain anchor, not
+        tip+1 — a quarantined mid-chain record is re-emitted even
+        though periods above it are stored."""
+        store = UpdateStore(str(tmp_path))
+        for p, pos in ((1, "0xa"), (2, "0xb"), (3, "0xc")):
+            store.append_committee(p, {"committee_poseidon": pos})
+        beacon = FakeBeacon(TINY, fin_slot=3 * TINY.slots_per_period + 16)
+        tr = HeadTracker(beacon, TINY, store)
+        assert tr.poll() == []                  # chain complete: no work
+        faults.install_plan("artifact.read:corrupt:1")
+        assert store.get_committee(2) is None   # mid-chain invalidation
+        assert store.tip_period() == 3
+        items = tr.poll()
+        assert [i.period for i in items] == [2]  # hole BELOW the tip
+        store.append_committee(2, {"committee_poseidon": "0xb"})
+        assert store.verify_chain()
+        assert tr.poll() == []
+
+    def test_store_retry_backoff_honored_on_collect_path(self, tmp_path):
+        """REVIEW: the keep_job backoff after a store-write OSError must
+        actually delay the next append attempt — pump cycles inside the
+        window skip the entry instead of hammering a full disk."""
+        clk = {"t": 0.0}
+        attempts = {"n": 0}
+
+        class FullDiskStore(UpdateStore):
+            def append_committee(self, *a, **kw):
+                attempts["n"] += 1
+                raise OSError("No space left on device")
+
+        jobs = ScriptedJobs()
+        sched = ProofScheduler(jobs, FullDiskStore(str(tmp_path)),
+                               clock=lambda: clk["t"])
+        sched.offer([CommitteeUpdateDue(1, {"light_client_update": {}})])
+        sched.pump()
+        jobs.finish("j1", {"committee_poseidon": "0xa"})
+        sched.pump()
+        assert attempts["n"] == 1
+        sched.pump()                    # inside the 1 s backoff window
+        sched.pump()
+        assert attempts["n"] == 1       # backoff honored, no hammering
+        clk["t"] = 1.5                  # past the window
+        sched.pump()
+        assert attempts["n"] == 2
+
+    def test_replay_skips_corrupt_midline_keeps_tail(self, tmp_path):
+        """REVIEW: a corrupt journal line mid-file (bit rot) is skipped
+        and counted; only a torn LAST line truncates the replay."""
+        store = UpdateStore(str(tmp_path))
+        store.append_committee(1, {"committee_poseidon": "0xa"})
+        store.append_committee(2, {"committee_poseidon": "0xb"})
+        with open(store.path) as f:
+            lines = f.read().splitlines()
+        lines.insert(1, '{"kind": "committe')        # rot mid-file
+        with open(store.path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        before = _counter("follower_journal_corrupt_lines")
+        store2 = UpdateStore(str(tmp_path))
+        assert sorted(store2._committee) == [1, 2]   # tail survived
+        assert _counter("follower_journal_corrupt_lines") == before + 1
+        assert store2.verify_chain()
+
+        # a torn last line is still a tolerated crash footprint
+        with open(store.path, "a") as f:
+            f.write('{"kind": "step", "slot"')
+        b2 = _counter("follower_journal_corrupt_lines")
+        store3 = UpdateStore(str(tmp_path))
+        assert sorted(store3._committee) == [1, 2]
+        # the mid-file rot still counts (+1); the torn tail adds nothing
+        assert _counter("follower_journal_corrupt_lines") == b2 + 1
 
 
 class TestTracker:
