@@ -1,13 +1,13 @@
 # Build orchestration (reference parity: `justfile` recipes).
 
-.PHONY: all native test test-slow test-faults fixtures bench bench-fast setup-committee setup-step lint lint-fast tpu-evidence report-ci
+.PHONY: all native test test-slow test-faults test-farm fixtures bench bench-fast setup-committee setup-step lint lint-fast tpu-evidence report-ci
 
 all: native
 
 native:
 	$(MAKE) -C spectre_tpu/native
 
-test: native lint test-faults bench-fast
+test: native lint test-faults test-farm bench-fast
 	python -m pytest tests/ -q
 
 # fault-injection tier (PR 3, grown in PR 6): deterministic resilience
@@ -29,7 +29,16 @@ test: native lint test-faults bench-fast
 # degrade/recover, corrupt-stored-update quarantine + re-prove.
 # Also part of the full pytest ladder above.
 test-faults: native
-	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py tests/test_service.py tests/test_observability.py tests/test_manifest.py tests/test_integrity.py tests/test_follower.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py tests/test_service.py tests/test_observability.py tests/test_manifest.py tests/test_integrity.py tests/test_follower.py tests/test_farm.py -q
+
+# proof-farm failover matrix (PR 11, tests/test_farm.py): replica crash
+# mid-prove -> lease takeover with a byte-identical proof, breaker-open
+# replica receives no work, SDC re-prove on a DIFFERENT replica
+# (cross-host verification), dispatcher restart replays leases without
+# double-proving, beacon quorum ignores a lone dissenting head, and the
+# UpdateStore 10k-period RSS bound.
+test-farm: native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_farm.py -q
 
 test-slow: native
 	RUN_SLOW=1 python -m pytest tests/ -q

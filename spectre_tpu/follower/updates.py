@@ -41,6 +41,7 @@ import json
 import os
 import threading
 import time
+from collections import OrderedDict
 
 from ..utils import faults
 from ..utils.artifacts import ArtifactCorrupt, ArtifactStore
@@ -49,6 +50,113 @@ from ..utils.health import HEALTH
 JOURNAL_NAME = "follower.updates.jsonl"
 UPDATE_SUFFIX = ".update.json"
 JOURNAL_FAULT_SITE = "follower.journal"
+
+# in-RAM record-cache bound (ISSUE 11 satellite): a years-long follower
+# accumulates tens of thousands of periods; the full journal records
+# stay on disk and only this many stay hot in RAM per map
+CACHE_PERIODS_ENV = "SPECTRE_UPDATE_CACHE_PERIODS"
+DEFAULT_CACHE_PERIODS = 1024
+
+
+class _JournalMap:
+    """Bounded dict façade over journal-backed records (ISSUE 11).
+
+    The full index (key -> (journal byte offset, artifact digest)) is
+    tiny and stays resident — membership, iteration, len, max/min and
+    the scrubber keep-set never load a record. Full records live in an
+    LRU capped at `cache` entries; a miss seeks the journal to the
+    record's offset and re-parses that one line
+    (``follower_update_cache_evictions`` / reload failures are counted,
+    a reloaded line that no longer parses or no longer matches its key
+    is bit rot: the index entry is dropped so the follower re-proves).
+
+    NOT thread-safe on its own — every access happens under the owning
+    UpdateStore's lock, exactly like the plain dicts it replaces."""
+
+    def __init__(self, path: str, kind: str, key_field: str,
+                 cache: int, health=HEALTH):
+        self._path = path
+        self._kind = kind
+        self._key_field = key_field
+        self._cache = max(1, int(cache))
+        self._health = health
+        self._index: dict[int, tuple] = {}      # key -> (offset, digest)
+        self._lru: "OrderedDict[int, dict]" = OrderedDict()
+
+    # -- dict façade (what UpdateStore + tests use) ------------------------
+
+    def __contains__(self, key) -> bool:
+        return key in self._index
+
+    def __iter__(self):
+        return iter(self._index)
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def __getitem__(self, key) -> dict:
+        rec = self._lru.get(key)
+        if rec is not None:
+            self._lru.move_to_end(key)
+            return rec
+        if key not in self._index:
+            raise KeyError(key)
+        rec = self._reload(key)
+        if rec is None:
+            # the journal line rotted underneath the index: drop the
+            # entry (the tracker re-emits the period, the scheduler
+            # re-proves it — same contract as read-time invalidation)
+            del self._index[key]
+            self._health.incr("follower_journal_reload_failures")
+            raise KeyError(key)
+        self._insert(key, rec)
+        return rec
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def __delitem__(self, key):
+        del self._index[key]
+        self._lru.pop(key, None)
+
+    def keys(self):
+        return self._index.keys()
+
+    # -- journal-backed side ----------------------------------------------
+
+    def put(self, key, rec: dict, offset: int):
+        self._index[key] = (offset, rec.get("digest"))
+        self._insert(key, rec)
+
+    def digests(self) -> set:
+        """Artifact digests of every indexed record — no record loads."""
+        return {d for _, d in self._index.values() if d}
+
+    def _insert(self, key, rec: dict):
+        self._lru[key] = rec
+        self._lru.move_to_end(key)
+        while len(self._lru) > self._cache:
+            self._lru.popitem(last=False)
+            self._health.incr("follower_update_cache_evictions")
+
+    def _reload(self, key) -> dict | None:
+        offset, _digest = self._index[key]
+        try:
+            with open(self._path, "rb") as f:
+                f.seek(offset)
+                rec = json.loads(f.readline())
+        except (OSError, ValueError):
+            return None
+        try:
+            if rec.get("kind") != self._kind \
+                    or int(rec[self._key_field]) != key:
+                return None
+        except (KeyError, TypeError, ValueError):
+            return None
+        return rec
 
 
 class ChainOrderError(RuntimeError):
@@ -69,15 +177,23 @@ class UpdateStore:
     queue — register :meth:`live_artifacts` with the queue's scrubber
     keep-set so stored updates are never expired as orphans."""
 
-    def __init__(self, directory: str, health=HEALTH):
+    def __init__(self, directory: str, health=HEALTH,
+                 cache_periods: int | None = None):
         os.makedirs(directory, exist_ok=True)
         self.dir = directory
         self.health = health
         self.store = ArtifactStore(directory, health=health)
         self.path = os.path.join(directory, JOURNAL_NAME)
         self._lock = threading.RLock()
-        self._committee: dict[int, dict] = {}   # period -> journal record
-        self._steps: dict[int, dict] = {}       # slot -> journal record
+        if cache_periods is None:
+            cache_periods = int(os.environ.get(CACHE_PERIODS_ENV)
+                                or DEFAULT_CACHE_PERIODS)
+        # period -> record / slot -> record, bounded (ISSUE 11): the
+        # resident index is offsets+digests only, full records LRU-cache
+        self._committee = _JournalMap(self.path, "committee", "period",
+                                      cache_periods, health=health)
+        self._steps = _JournalMap(self.path, "step", "slot",
+                                  cache_periods, health=health)
         # lowest committee period ever journaled — the chain's trust
         # anchor. Survives in-memory invalidations (a dropped record is
         # re-proved, not forgotten) so the tracker can re-derive holes
@@ -87,14 +203,19 @@ class UpdateStore:
 
     # -- journal -----------------------------------------------------------
 
-    def _append(self, record: dict):
+    def _append(self, record: dict) -> int:
+        """Append one record; returns its byte offset in the journal
+        (the _JournalMap index key for cache-miss reloads)."""
         faults.check(JOURNAL_FAULT_SITE)
         line = json.dumps(record, sort_keys=True,
                           separators=(",", ":")) + "\n"
         with open(self.path, "a") as f:
+            f.seek(0, os.SEEK_END)
+            offset = f.tell()
             f.write(line)
             f.flush()
             os.fsync(f.fileno())
+        return offset
 
     def _replay(self):
         """Rebuild the maps from the journal (last record per key wins;
@@ -106,26 +227,32 @@ class UpdateStore:
         discarding every valid record after it."""
         if not os.path.exists(self.path):
             return
-        with open(self.path) as f:
-            lines = f.read().splitlines()
-        for i, line in enumerate(lines):
+        with open(self.path, "rb") as f:
+            raw = f.read()
+        entries, pos = [], 0
+        for chunk in raw.split(b"\n"):
+            entries.append((pos, chunk))
+            pos += len(chunk) + 1
+        if entries and not entries[-1][1].strip():
+            entries.pop()       # trailing empty chunk: file ends with \n
+        for i, (offset, line) in enumerate(entries):
             line = line.strip()
             if not line:
                 continue
             try:
                 rec = json.loads(line)
             except ValueError:
-                if i == len(lines) - 1:
+                if i == len(entries) - 1:
                     break          # torn tail: everything before is good
                 self.health.incr("follower_journal_corrupt_lines")
                 continue
             if rec.get("kind") == "committee":
                 period = int(rec["period"])
-                self._committee[period] = rec
+                self._committee.put(period, rec, offset)
                 if self._anchor is None or period < self._anchor:
                     self._anchor = period
             elif rec.get("kind") == "step":
-                self._steps[int(rec["slot"])] = rec
+                self._steps.put(int(rec["slot"]), rec, offset)
         if self._committee or self._steps:
             self.health.incr("follower_journal_replays")
         self._verify_tip()
@@ -138,7 +265,11 @@ class UpdateStore:
         tip = self.tip_period()
         if tip is None:
             return
-        rec = self._committee[tip]
+        try:
+            rec = self._committee[tip]
+        except KeyError:        # reload failed: already dropped + counted
+            self.health.incr("follower_chain_tip_invalid")
+            return
         try:
             result = json.loads(self.store.read(rec["digest"],
                                                 UPDATE_SUFFIX))
@@ -194,8 +325,8 @@ class UpdateStore:
                 "manifest_digest": manifest_digest,
                 "ts": time.time(),
             }
-            self._append(rec)
-            self._committee[period] = rec
+            offset = self._append(rec)
+            self._committee.put(period, rec, offset)
             if self._anchor is None or period < self._anchor:
                 self._anchor = period
         self.health.incr("follower_updates_stored")
@@ -211,8 +342,8 @@ class UpdateStore:
             rec = {"kind": "step", "slot": slot, "digest": digest,
                    "job_id": job_id, "manifest_digest": manifest_digest,
                    "ts": time.time()}
-            self._append(rec)
-            self._steps[slot] = rec
+            offset = self._append(rec)
+            self._steps.put(slot, rec, offset)
         self.health.incr("follower_steps_stored")
         return rec
 
@@ -313,17 +444,22 @@ class UpdateStore:
             if periods != list(range(periods[0], periods[-1] + 1)):
                 return False
             for p in periods[1:]:
-                if self._committee[p].get("prev_poseidon") != \
-                        self._committee[p - 1].get("committee_poseidon"):
+                cur = self._committee.get(p)
+                prev = self._committee.get(p - 1)
+                if cur is None or prev is None:     # rotted under the index
+                    return False
+                if cur.get("prev_poseidon") != prev.get("committee_poseidon"):
                     return False
             return True
 
     def live_artifacts(self) -> set:
         """(digest, suffix) keep-set for the artifact scrubber: stored
-        updates must never be expired as journal orphans."""
+        updates must never be expired as journal orphans. Reads the
+        resident index only — no record loads, regardless of chain
+        length."""
         with self._lock:
-            recs = list(self._committee.values()) + list(self._steps.values())
-        return {(r["digest"], UPDATE_SUFFIX) for r in recs}
+            digs = self._committee.digests() | self._steps.digests()
+        return {(d, UPDATE_SUFFIX) for d in digs}
 
     def snapshot(self) -> dict:
         with self._lock:

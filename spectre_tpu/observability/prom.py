@@ -160,6 +160,38 @@ def render(health=None, jobs=None, registry=None) -> str:
                     {"base_url": b["base_url"]}, b["consecutive_failures"])
 
     try:
+        from ..prover_service.dispatcher import dispatcher_snapshot
+        replicas = dispatcher_snapshot()
+    except Exception:
+        replicas = []
+    if replicas:
+        for key, kind, help_ in (
+                ("breaker_state", "gauge",
+                 "Replica circuit-breaker state "
+                 "(0=closed 1=half-open 2=open)"),
+                ("consecutive_failures", "gauge",
+                 "Consecutive failures per prover replica"),
+                ("active_leases", "gauge",
+                 "Jobs currently leased to the replica"),
+                ("healthy", "gauge",
+                 "Last health-probe result (1=healthy 0=unhealthy; "
+                 "absent until first probe)")):
+            mn = f"spectre_replica_{key}"
+            _family(out, mn, kind, help_)
+            for r in replicas:
+                if key == "breaker_state":
+                    v = r["breaker"]["state_code"]
+                elif key == "consecutive_failures":
+                    v = r["breaker"]["consecutive_failures"]
+                elif key == "healthy":
+                    if r["healthy"] is None:
+                        continue
+                    v = int(r["healthy"])
+                else:
+                    v = r[key]
+                _sample(out, mn, {"replica": r["replica_id"]}, v)
+
+    try:
         from ..follower.daemon import follower_snapshot
         followers = follower_snapshot()
     except Exception:

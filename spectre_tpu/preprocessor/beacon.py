@@ -29,6 +29,14 @@ prover. Every GET therefore runs under:
 Retries/trips/half-opens are counted on utils.health (HEALTH) and the
 fault-injection site `beacon.fetch` (utils/faults) fires before each
 attempt, so every path above is deterministically testable in CI.
+
+ISSUE 11 (proof farm): the breaker state machine moved to
+``utils/breaker.CircuitBreaker`` (the dispatcher reuses it per prover
+replica); this client keeps its exact public surface on top. New here:
+:class:`BeaconQuorum` — an N-client pool that only acts on a finalized
+head at least ``quorum`` beacons agree on, demoting a lone dissenting
+(lying or forked) beacon behind its own breaker so it cannot stall or
+fork the follower chain (``beacon_quorum_dissent`` counts it).
 """
 
 from __future__ import annotations
@@ -41,11 +49,17 @@ import urllib.error
 import urllib.request
 
 from ..utils import faults
+from ..utils.breaker import BreakerOpen, CircuitBreaker
 from ..utils.health import HEALTH
 
 
 class CircuitBreakerOpen(RuntimeError):
     """Failing fast: the breaker is open (upstream considered down)."""
+
+
+class QuorumNotReached(RuntimeError):
+    """The beacon pool could not assemble `quorum` matching finalized
+    heads — no single answer is trustworthy enough to act on."""
 
 
 def _env_float(name: str, default: float) -> float:
@@ -134,47 +148,43 @@ class BeaconClient:
         self.health = health
         self._sleep = sleep
         self._rng = rng
-        # breaker state: consecutive failures + open-until timestamp
-        self._consecutive_failures = 0
-        self._opened_at: float | None = None
-        self._half_open = False
+        # breaker state machine shared with the dispatcher (utils/breaker)
+        self._breaker = CircuitBreaker(
+            threshold=self.breaker_threshold,
+            cooldown=self.breaker_cooldown,
+            health=health, counter_prefix="beacon_breaker")
         _CLIENTS.add(self)     # readiness registry (breaker_snapshot)
 
     # -- circuit breaker ---------------------------------------------------
 
     @property
     def breaker_state(self) -> str:
-        if self._opened_at is None:
-            return "closed"
-        if time.time() - self._opened_at >= self.breaker_cooldown:
-            return "half-open"
-        return "open"
+        return self._breaker.state
+
+    @property
+    def _consecutive_failures(self) -> int:
+        return self._breaker.consecutive_failures
 
     def _breaker_admit(self):
-        state = self.breaker_state
-        if state == "open":
-            remain = self.breaker_cooldown - (time.time() - self._opened_at)
+        try:
+            self._breaker.admit()
+        except BreakerOpen:
             raise CircuitBreakerOpen(
-                f"beacon circuit breaker open for another {remain:.1f}s "
-                f"after {self._consecutive_failures} consecutive failures")
-        if state == "half-open" and not self._half_open:
-            self._half_open = True
-            self.health.incr("beacon_breaker_half_open")
+                f"beacon circuit breaker open for another "
+                f"{self._breaker.remaining():.1f}s after "
+                f"{self._consecutive_failures} consecutive failures") \
+                from None
 
     def _breaker_record(self, ok: bool):
-        if ok:
-            self._consecutive_failures = 0
-            self._opened_at = None
-            self._half_open = False
-            return
-        self._consecutive_failures += 1
-        half_open_failed = self._half_open
-        self._half_open = False
-        if (half_open_failed
-                or self._consecutive_failures >= self.breaker_threshold):
-            if self._opened_at is None or half_open_failed:
-                self.health.incr("beacon_breaker_trips")
-            self._opened_at = time.time()
+        self._breaker.record(ok)
+
+    def demote(self) -> None:
+        """Penalize this beacon without a network call: a quorum
+        dissent (divergent finalized head) counts as a failure, so a
+        persistently lying/forked beacon trips its own breaker and
+        drops out of the pool until cooldown."""
+        self._breaker.record(False)
+        self.health.incr("beacon_demoted")
 
     # -- retried GET -------------------------------------------------------
 
@@ -250,6 +260,109 @@ class BeaconClient:
 
     def head_block_root(self) -> str:
         return self._get("/eth/v1/beacon/blocks/head/root")["data"]["root"]
+
+    def sync_period(self, spec, slot: int) -> int:
+        return spec.sync_period(slot)
+
+
+class BeaconQuorum:
+    """N-beacon pool requiring `quorum` agreement on the finalized head.
+
+    The follower's head tracker polls one beacon today; a lying (or
+    long-forked) beacon can stall the chain or feed it a head the
+    committee chain will never verify against. The quorum pool polls
+    every non-breaker-open client, groups their finalized headers by
+    canonical JSON, and only returns a head at least ``quorum`` beacons
+    agree on. A dissenting minority is demoted behind each client's own
+    breaker (``beacon_quorum_dissent``), so one bad beacon degrades to
+    harmless noise instead of a fork.
+
+    Drop-in for :class:`BeaconClient` where the follower consumes it:
+    `finality_update` / `committee_updates` / `bootstrap` /
+    `head_block_root` / `sync_period` are provided; the non-quorum
+    endpoints simply fail over through healthy clients in order.
+    """
+
+    def __init__(self, clients, quorum: int | None = None, health=HEALTH):
+        if not clients:
+            raise ValueError("BeaconQuorum needs at least one BeaconClient")
+        self.clients = list(clients)
+        self.quorum = min(len(self.clients),
+                          quorum if quorum is not None
+                          else _env_int("SPECTRE_BEACON_QUORUM", 2))
+        self.health = health
+
+    # -- quorum head -------------------------------------------------------
+
+    @staticmethod
+    def _head_key(update: dict) -> str:
+        hdr = update.get("finalized_header", update)
+        return json.dumps(hdr, sort_keys=True, separators=(",", ":"))
+
+    def finality_update(self) -> dict:
+        """Finalized head at least `quorum` beacons agree on.
+
+        Breaker-open clients are skipped; per-client fetch errors are
+        tolerated (counted on ``beacon_quorum_errors``) as long as a
+        quorum remains. Raises :class:`QuorumNotReached` otherwise."""
+        votes: dict[str, list] = {}   # head key -> [(client, update), ...]
+        errors = 0
+        for c in self.clients:
+            if c.breaker_state == "open":
+                continue
+            try:
+                upd = c.finality_update()
+            except faults.InjectedCrash:
+                raise
+            except Exception:
+                errors += 1
+                self.health.incr("beacon_quorum_errors")
+                continue
+            votes.setdefault(self._head_key(upd), []).append((c, upd))
+        if not votes:
+            self.health.incr("beacon_quorum_failures")
+            raise QuorumNotReached(
+                f"no beacon answered ({errors} errors, "
+                f"{len(self.clients)} clients)")
+        best_key = max(votes, key=lambda k: len(votes[k]))
+        if len(votes[best_key]) < self.quorum:
+            self.health.incr("beacon_quorum_failures")
+            raise QuorumNotReached(
+                f"finalized heads split {sorted(len(v) for v in votes.values())} "
+                f"across {len(votes)} answers; need {self.quorum} matching")
+        for key, members in votes.items():
+            if key == best_key:
+                continue
+            for c, _ in members:
+                c.demote()
+                self.health.incr("beacon_quorum_dissent")
+        return votes[best_key][0][1]
+
+    # -- failover passthrough ---------------------------------------------
+
+    def _any(self, fn_name: str, *args, **kw):
+        last_exc: Exception | None = None
+        for c in self.clients:
+            if c.breaker_state == "open":
+                continue
+            try:
+                return getattr(c, fn_name)(*args, **kw)
+            except faults.InjectedCrash:
+                raise
+            except Exception as exc:
+                last_exc = exc
+                self.health.incr("beacon_quorum_errors")
+        raise last_exc if last_exc is not None else CircuitBreakerOpen(
+            f"all {len(self.clients)} beacon breakers open")
+
+    def committee_updates(self, period: int, count: int = 1) -> list[dict]:
+        return self._any("committee_updates", period, count)
+
+    def bootstrap(self, block_root: str) -> dict:
+        return self._any("bootstrap", block_root)
+
+    def head_block_root(self) -> str:
+        return self._any("head_block_root")
 
     def sync_period(self, spec, slot: int) -> int:
         return spec.sync_period(slot)

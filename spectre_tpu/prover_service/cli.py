@@ -69,6 +69,20 @@ def main(argv=None):
                    help="supervisor stall threshold: a worker whose "
                    "heartbeat is older than this is replaced and its job "
                    "failed (default: $SPECTRE_WORKER_STALL_S or 600)")
+    r.add_argument("--replicas", default=None,
+                   help="comma-separated prover replica URLs (default "
+                        "$SPECTRE_REPLICAS): serve as a proof-farm "
+                        "dispatcher over them instead of proving "
+                        "locally (ISSUE 11)")
+    r.add_argument("--replica-id", default=None,
+                   help="this server's replica id within a farm "
+                        "(default $SPECTRE_REPLICA_ID); stamped into "
+                        "RPC errors and proof manifests")
+    r.add_argument("--lease-s", type=float, default=None,
+                   help="dispatcher lease duration in seconds (default "
+                        "$SPECTRE_REPLICA_LEASE_S or 120): a replica "
+                        "owns a job only while its heartbeat renews "
+                        "within this window")
     r.add_argument("--trace-dir", default=None,
                    help="write each completed job's span tree as Chrome "
                    "trace-event JSON (<job_id>.trace.json) under this "
@@ -80,7 +94,14 @@ def main(argv=None):
                        "track the beacon head, prove steps + committee "
                        "updates, serve verified updates over the RPC API")
     f.add_argument("--beacon-api", required=True,
-                   help="Beacon REST base URL to follow")
+                   help="Beacon REST base URL; pass a comma-separated "
+                        "list to poll a quorum (2-of-N agreement on the "
+                        "finalized head; a lone dissenting beacon is "
+                        "demoted behind its breaker)")
+    f.add_argument("--beacon-quorum", type=int, default=None,
+                   help="matching finalized heads required before the "
+                        "follower acts (default $SPECTRE_BEACON_QUORUM "
+                        "or 2, clamped to the pool size)")
     f.add_argument("--params-dir", required=True,
                    help="SRS/pk cache dir; hosts the job journal AND the "
                    "follower's verified update store "
@@ -166,7 +187,25 @@ def main(argv=None):
             queue_kw["mem_watermark_mb"] = args.mem_watermark_mb
         if args.worker_stall_s is not None:
             queue_kw["stall_timeout"] = args.worker_stall_s
+        dispatcher = None
+        replicas_raw = args.replicas or os.environ.get("SPECTRE_REPLICAS")
+        if replicas_raw:
+            # proof farm (ISSUE 11): this process becomes the dispatcher
+            # head — jobs route to the replica fleet; the local state
+            # only cross-verifies what the replicas return
+            from .dispatcher import Dispatcher, HttpReplica
+            from .rpc_client import ProverClient
+            urls = [u.strip() for u in replicas_raw.split(",") if u.strip()]
+            dispatcher = Dispatcher(
+                replicas=[HttpReplica(url, ProverClient(url))
+                          for url in urls],
+                journal_dir=args.params_dir, lease_s=args.lease_s,
+                verify_state=state)
+            print(f"dispatching over {len(urls)} replicas "
+                  f"(lease {dispatcher.lease_s:g}s, cross-verify on)",
+                  flush=True)
         serve(state, args.host, args.port, job_timeout=args.job_timeout,
+              dispatcher=dispatcher, replica_id=args.replica_id,
               **queue_kw)
     elif args.cmd == "utils":
         _utils_cmd(args, spec)
@@ -189,7 +228,7 @@ def _follow_cmd(args, spec):
 
     from ..follower import Follower
     from ..observability import compilelog
-    from ..preprocessor.beacon import BeaconClient
+    from ..preprocessor.beacon import BeaconClient, BeaconQuorum
     from .jobs import ensure_jobs
     from .rpc import serve
     from .state import ProverState
@@ -214,7 +253,18 @@ def _follow_cmd(args, spec):
         queue_kw["queue_depth"] = args.queue_depth
     jobs = ensure_jobs(state, journal_dir=args.params_dir,
                        default_timeout=args.job_timeout, **queue_kw)
-    beacon = BeaconClient(args.beacon_api)
+    beacon_urls = [u.strip() for u in args.beacon_api.split(",")
+                   if u.strip()]
+    if len(beacon_urls) > 1:
+        # multi-beacon quorum (ISSUE 11 satellite): the follower acts
+        # only on a finalized head 2-of-N beacons agree on; a lone
+        # lying/forked beacon is demoted behind its own breaker
+        beacon = BeaconQuorum([BeaconClient(u) for u in beacon_urls],
+                              quorum=args.beacon_quorum)
+        print(f"beacon quorum: {beacon.quorum}-of-{len(beacon_urls)}",
+              flush=True)
+    else:
+        beacon = BeaconClient(beacon_urls[0])
     fol = Follower(spec, beacon, jobs, directory=args.params_dir,
                    pubkeys=pubkeys, domain=domain, backfill=args.backfill)
     serve(state, args.host, args.port, background=True,

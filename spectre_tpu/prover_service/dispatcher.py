@@ -1,0 +1,546 @@
+"""Proof-farm dispatcher: fault-tolerant dispatch over prover replicas.
+
+One box is the ceiling ROADMAP's "Proof farm" item names: the JobQueue
+runs every prove on the local ProverState. This module lifts the PR-6
+worker-supervision pattern one level — from threads inside one process
+to replicas across hosts — so the service survives a replica dying
+mid-prove, a silently corrupting host, or a whole rack going dark:
+
+* **Replicas** register with a capability/health record.
+  :class:`LocalReplica` wraps an in-process ProverState (or any runner
+  callable — tests use canned runners); :class:`HttpReplica` fronts a
+  remote prover via the existing ``rpc_client`` submit/poll API.
+* **Routing** is rendezvous hashing on the witness digest — the
+  JobQueue's existing dedup key — so retries and resubmits of the same
+  witness land on the same replica (warm caches) without any shared
+  routing state.
+* **Leases**: a replica owns a job only while its heartbeat renews.
+  A crashed replica signals nothing (its prove thread just dies); a
+  stalled one stops renewing; either way the lease expires and the job
+  is re-dispatched with the failed replica excluded
+  (``dispatcher_lease_takeovers``). Grants and releases are journaled
+  (``dispatcher.leases.jsonl``, fsync'd like the job journal), so a
+  dispatcher restart replays open leases as exclusions instead of
+  re-trusting the replica that died holding them — combined with the
+  queue's witness-digest dedup, a restart never double-proves.
+* **Per-replica circuit breaker** — the exact beacon breaker machinery
+  (utils/breaker.py): N consecutive failures stop a replica receiving
+  work for a cooldown, one half-open trial re-admits it.
+* **Cross-host verification** (closes the PR-9 carry): with a
+  ``verify_state``, every proof a replica returns is re-verified by the
+  *dispatcher's* host before release; a verify failure quarantines the
+  bytes and re-dispatches to a *different* replica
+  (``dispatcher_sdc_rerouted``) — a bad DIMM can no longer hit both the
+  prove and the retry.
+
+The Dispatcher is callable with the JobQueue runner signature
+``(method, params, heartbeat=None)``, so ``ensure_jobs(state,
+runner=dispatcher)`` points an unchanged queue (and the follower above
+it) at the farm. Fault sites ``replica.dispatch`` / ``replica.health`` /
+``replica.lease`` (utils/faults.py) make the whole failover matrix
+drillable; every ``dispatcher_*`` counter rides HEALTH.snapshot() into
+``/healthz`` and ``/metrics`` with zero exporter changes.
+
+Importable without jax (prom.py pulls :func:`dispatcher_snapshot`);
+heavy prover imports stay inside the replica prove paths.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import weakref
+
+from ..observability import manifest as obs_manifest
+from ..utils import faults
+from ..utils.breaker import BreakerOpen, CircuitBreaker
+from ..utils.health import HEALTH
+
+LEASE_JOURNAL_NAME = "dispatcher.leases.jsonl"
+
+# exclusion-map bound: digests of completed jobs are dropped eagerly;
+# this caps pathological churn (many distinct failing digests)
+_MAX_EXCLUDED_DIGESTS = 4096
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v else default
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+class NoReplicaAvailable(RuntimeError):
+    """Every candidate replica is excluded, unhealthy, breaker-open or
+    has already failed this job."""
+
+
+def _is_infra_error(exc: BaseException) -> bool:
+    """Failures worth failing over: another replica may well succeed.
+
+    Deterministic prover errors (witness rejection, verify failure,
+    bad params) re-raise unchanged so the RPC error taxonomy — and any
+    caller matching on exception class — sees exactly what a
+    single-replica deployment would."""
+    if isinstance(exc, (TimeoutError, ConnectionError,
+                        faults.InjectedFault, OSError)):
+        return True
+    # RpcError from an HttpReplica: retry elsewhere only for
+    # overload/internal; -32000/-32005-style outcomes are deterministic
+    return getattr(exc, "code", None) in (-32001, -32603)
+
+
+# -- replicas ---------------------------------------------------------------
+
+
+class Replica:
+    """Registration record + prove entry for one prover replica."""
+
+    def __init__(self, replica_id: str, capabilities=None):
+        self.replica_id = str(replica_id)
+        # None = all methods; otherwise the set of RPC methods served
+        self.capabilities = set(capabilities) if capabilities else None
+
+    def supports(self, method: str) -> bool:
+        return self.capabilities is None or method in self.capabilities
+
+    def healthy(self) -> bool:
+        faults.check("replica.health")
+        return self._healthy()
+
+    def _healthy(self) -> bool:
+        return True
+
+    def prove(self, method: str, params: dict, heartbeat=None) -> dict:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.replica_id}>"
+
+
+class LocalReplica(Replica):
+    """In-process replica: proves on a ProverState (or a custom runner
+    callable with the queue-runner signature — tests use canned ones)."""
+
+    def __init__(self, replica_id: str, state=None, runner=None,
+                 capabilities=None):
+        super().__init__(replica_id, capabilities)
+        self.state = state
+        self._runner = runner
+
+    def prove(self, method: str, params: dict, heartbeat=None) -> dict:
+        faults.check("replica.dispatch")
+        if self._runner is not None:
+            return self._runner(method, params, heartbeat=heartbeat)
+        from .rpc import run_proof_method
+        return run_proof_method(self.state, method, params,
+                                heartbeat=heartbeat)
+
+    def _healthy(self) -> bool:
+        return self.state is not None or self._runner is not None
+
+
+class HttpReplica(Replica):
+    """Remote replica via the resilient rpc_client: submit + poll, each
+    status poll renewing the dispatcher lease (heartbeat)."""
+
+    def __init__(self, replica_id: str, client, poll_s: float = 1.0,
+                 sleep=time.sleep, capabilities=None):
+        super().__init__(replica_id, capabilities)
+        self.client = client
+        self.poll_s = poll_s
+        self._sleep = sleep
+
+    def _healthy(self) -> bool:
+        try:
+            return self.client.ping() == "pong"
+        except faults.InjectedCrash:
+            raise
+        except Exception:
+            return False
+
+    def prove(self, method: str, params: dict, heartbeat=None) -> dict:
+        faults.check("replica.dispatch")
+        from .rpc import (RPC_METHOD_COMMITTEE, RPC_METHOD_COMMITTEE_SUBMIT,
+                          RPC_METHOD_STEP, RPC_METHOD_STEP_SUBMIT)
+        submit = {RPC_METHOD_STEP: RPC_METHOD_STEP_SUBMIT,
+                  RPC_METHOD_COMMITTEE: RPC_METHOD_COMMITTEE_SUBMIT
+                  }.get(method)
+        if submit is None:
+            return self.client._call(method, params)
+        jid = self.client._call_shedding(
+            submit, params,
+            timeout=min(self.client.timeout, 60.0))["job_id"]
+        while True:
+            st = self.client.proof_status(jid)
+            if heartbeat is not None:
+                heartbeat()      # remote made progress -> renew the lease
+            if st["status"] in ("done", "failed", "cancelled"):
+                return self.client.proof_result(jid)
+            self._sleep(self.poll_s)
+
+
+# -- registry for /metrics (prom.py) ---------------------------------------
+
+_DISPATCHERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def dispatcher_snapshot() -> list[dict]:
+    """Per-replica state of every live Dispatcher, for the Prometheus
+    exporter (spectre_replica_* gauges) — mirrors beacon.breaker_snapshot."""
+    out: list[dict] = []
+    for d in list(_DISPATCHERS):
+        out.extend(d.snapshot()["replicas"])
+    return out
+
+
+# -- dispatcher -------------------------------------------------------------
+
+
+class Dispatcher:
+    """Routes queue jobs across replicas with leases, breakers and
+    cross-host verification. Callable with the JobQueue runner
+    signature, so ``ensure_jobs(state, runner=dispatcher)`` is the whole
+    integration."""
+
+    def __init__(self, replicas=(), journal_dir=None, lease_s=None,
+                 verify_state=None, health=HEALTH, clock=time.monotonic,
+                 poll_s: float = 0.02, health_ttl_s: float = 5.0,
+                 breaker_threshold: int | None = None,
+                 breaker_cooldown: float | None = None):
+        self.lease_s = lease_s if lease_s is not None \
+            else _env_float("SPECTRE_REPLICA_LEASE_S", 120.0)
+        self.verify_state = verify_state
+        self.health = health
+        self._clock = clock
+        self.poll_s = poll_s
+        self.health_ttl_s = health_ttl_s
+        self._breaker_threshold = breaker_threshold \
+            if breaker_threshold is not None \
+            else _env_int("SPECTRE_REPLICA_CB_THRESHOLD", 5)
+        self._breaker_cooldown = breaker_cooldown \
+            if breaker_cooldown is not None \
+            else _env_float("SPECTRE_REPLICA_CB_COOLDOWN", 30.0)
+        self._lock = threading.Lock()
+        self.replicas: list[Replica] = []
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._stats: dict[str, dict] = {}
+        self._excluded: dict[str, set] = {}     # digest -> failed replica ids
+        self._takeover_due: set[str] = set()    # digests with a dead lease
+        self._active: dict[str, str] = {}       # digest -> replica id
+        self._health_cache: dict[str, tuple] = {}
+        self._queue = None                      # attached by ensure_jobs
+        for r in replicas:
+            self.register(r)
+        self._journal_path = None
+        if journal_dir is not None:
+            os.makedirs(journal_dir, exist_ok=True)
+            self._journal_path = os.path.join(journal_dir, LEASE_JOURNAL_NAME)
+            self._replay_journal()
+        _DISPATCHERS.add(self)
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, replica: Replica) -> None:
+        with self._lock:
+            if replica.replica_id in self._breakers:
+                raise ValueError(f"duplicate replica id {replica.replica_id}")
+            self.replicas.append(replica)
+            self._breakers[replica.replica_id] = CircuitBreaker(
+                threshold=self._breaker_threshold,
+                cooldown=self._breaker_cooldown,
+                health=self.health, counter_prefix="dispatcher_breaker")
+        self.health.incr("dispatcher_replicas_registered")
+
+    def breaker(self, replica_id: str) -> CircuitBreaker:
+        return self._breakers[replica_id]
+
+    def attach_queue(self, jobsq) -> None:
+        """Called by ensure_jobs: gives the dispatcher the queue's
+        artifact store (SDC quarantine) without a constructor cycle."""
+        self._queue = jobsq
+
+    # -- lease journal -----------------------------------------------------
+
+    def _replay_journal(self):
+        try:
+            with open(self._journal_path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return
+        open_leases: dict[str, str] = {}
+        failed: list[tuple] = []
+        for line in raw.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue        # torn tail (crash mid-append)
+            ev = rec.get("event")
+            if ev == "lease":
+                open_leases[rec["digest"]] = rec["replica"]
+            elif ev == "release":
+                open_leases.pop(rec["digest"], None)
+                if rec.get("outcome") != "done":
+                    failed.append((rec["digest"], rec["replica"]))
+        for digest, rid in failed:
+            self._excluded.setdefault(digest, set()).add(rid)
+        for digest, rid in open_leases.items():
+            # the previous dispatcher died while this replica held the
+            # lease: don't re-trust it for this digest, and count the
+            # first re-grant as a takeover
+            self._excluded.setdefault(digest, set()).add(rid)
+            self._takeover_due.add(digest)
+            self.health.incr("dispatcher_leases_replayed")
+
+    def _journal(self, rec: dict):
+        """fsync'd append; `replica.lease` fires AFTER a grant lands on
+        disk (the post-append crash window journal replay must cover).
+        IO errors are tolerated — the farm keeps proving with in-memory
+        lease state, counted on dispatcher_lease_journal_failures."""
+        try:
+            if self._journal_path is not None:
+                with open(self._journal_path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(rec, sort_keys=True) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+            if rec.get("event") == "lease":
+                faults.check("replica.lease")
+        except faults.InjectedCrash:
+            raise
+        except Exception:
+            self.health.incr("dispatcher_lease_journal_failures")
+
+    # -- routing -----------------------------------------------------------
+
+    def _healthy_cached(self, replica: Replica) -> bool:
+        now = self._clock()
+        cached = self._health_cache.get(replica.replica_id)
+        if cached is not None and now - cached[0] < self.health_ttl_s:
+            return cached[1]
+        try:
+            ok = bool(replica.healthy())
+        except faults.InjectedCrash:
+            raise
+        except Exception:
+            ok = False
+        self._health_cache[replica.replica_id] = (now, ok)
+        return ok
+
+    def _route(self, method: str, digest: str, excluded) -> Replica | None:
+        """Rendezvous hashing: stable per-digest replica ranking with no
+        shared routing state — the same witness always prefers the same
+        replica, and losing a replica only moves its own keys."""
+        ranked = sorted(self.replicas, key=lambda r: hashlib.sha256(
+            f"{digest}|{r.replica_id}".encode()).hexdigest())
+        for replica in ranked:
+            rid = replica.replica_id
+            if rid in excluded or not replica.supports(method):
+                continue
+            try:
+                self._breakers[rid].admit()
+            except BreakerOpen:
+                self.health.incr("dispatcher_breaker_skips")
+                continue
+            if not self._healthy_cached(replica):
+                self.health.incr("dispatcher_replica_unhealthy")
+                continue
+            return replica
+        return None
+
+    # -- lease lifecycle ---------------------------------------------------
+
+    def _grant(self, digest: str, rid: str, takeover: bool):
+        with self._lock:
+            self._active[digest] = rid
+            self._stats[rid] = st = self._stats.get(
+                rid, {"dispatched": 0, "failures": 0})
+            st["dispatched"] += 1
+        self.health.incr("dispatcher_jobs_dispatched")
+        if takeover:
+            self.health.incr("dispatcher_lease_takeovers")
+        obs_manifest.record_event("replica_lease", replica=rid,
+                                  takeover=bool(takeover))
+        self._journal({"event": "lease", "digest": digest, "replica": rid,
+                       "lease_s": self.lease_s, "takeover": bool(takeover),
+                       "ts": time.time()})
+
+    def _release(self, digest: str, rid: str, outcome: str):
+        with self._lock:
+            self._active.pop(digest, None)
+            if outcome != "done" and rid in self._stats:
+                self._stats[rid]["failures"] += 1
+        self._journal({"event": "release", "digest": digest, "replica": rid,
+                       "outcome": outcome, "ts": time.time()})
+
+    def _exclude(self, digest: str, rid: str):
+        with self._lock:
+            self._excluded.setdefault(digest, set()).add(rid)
+            while len(self._excluded) > _MAX_EXCLUDED_DIGESTS:
+                self._excluded.pop(next(iter(self._excluded)))
+
+    def _run_leased(self, replica: Replica, method: str, params: dict,
+                    heartbeat):
+        """Run one prove under a lease. Returns (outcome, result, exc):
+        outcome is "ok", "error" (replica raised), "crashed" (replica
+        thread died signalling nothing — InjectedCrash semantics), or
+        "expired" (heartbeat stopped renewing; thread disowned)."""
+        lease = {"expires": self._clock() + self.lease_s}
+
+        def renew():
+            lease["expires"] = self._clock() + self.lease_s
+            if heartbeat is not None:
+                heartbeat()
+
+        done = threading.Event()
+        box: dict = {}
+
+        def work():
+            try:
+                box["result"] = replica.prove(method, params, heartbeat=renew)
+            except faults.InjectedCrash:
+                # a dead replica writes nothing and renews nothing: no
+                # done.set() (deliberately NOT try/finally) — the main
+                # loop sees a dead thread and takes the lease back
+                return
+            except BaseException as exc:    # noqa: BLE001 — relayed below
+                box["exc"] = exc
+            done.set()
+
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"replica-{replica.replica_id}")
+        t.start()
+        while True:
+            if done.wait(self.poll_s):
+                if "exc" in box:
+                    return "error", None, box["exc"]
+                return "ok", box["result"], None
+            if heartbeat is not None:
+                heartbeat()     # supervising IS progress (queue-level stall
+                                # detection defers to lease expiry here)
+            if not t.is_alive():
+                if done.is_set():   # finished in the wait/is_alive window
+                    continue
+                return "crashed", None, None
+            if self._clock() >= lease["expires"]:
+                self.health.incr("dispatcher_lease_expired")
+                return "expired", None, None    # thread disowned
+
+    # -- dispatch ----------------------------------------------------------
+
+    def __call__(self, method: str, params: dict, heartbeat=None) -> dict:
+        return self.dispatch(method, params, heartbeat=heartbeat)
+
+    def dispatch(self, method: str, params: dict, heartbeat=None) -> dict:
+        from .jobs import witness_digest
+        digest = witness_digest(method, params)
+        with self._lock:
+            excluded = set(self._excluded.get(digest, ()))
+            lease_failed = digest in self._takeover_due
+            self._takeover_due.discard(digest)
+        tried: set[str] = set()
+        sdc_from: str | None = None
+        last_exc: BaseException | None = None
+        while True:
+            replica = self._route(method, digest, excluded | tried)
+            if replica is None:
+                self.health.incr("dispatcher_no_replica")
+                err = NoReplicaAvailable(
+                    f"no replica available for {method} (digest "
+                    f"{digest[:12]}…, {len(tried)} failed this dispatch, "
+                    f"{len(excluded)} excluded, "
+                    f"{len(self.replicas)} registered)")
+                raise err from last_exc
+            rid = replica.replica_id
+            self._grant(digest, rid, takeover=lease_failed)
+            lease_failed = False
+            outcome, result, exc = self._run_leased(
+                replica, method, params, heartbeat)
+            br = self._breakers[rid]
+
+            if outcome == "ok":
+                br.record(True)
+                verified = True
+                if self.verify_state is not None:
+                    from . import selfverify
+                    verified = selfverify.cross_verify(
+                        self.verify_state, method, result,
+                        health=self.health)
+                if verified:
+                    self._release(digest, rid, "done")
+                    with self._lock:
+                        self._excluded.pop(digest, None)
+                    if sdc_from is not None:
+                        obs_manifest.record_event(
+                            "sdc_reroute", from_replica=sdc_from,
+                            to_replica=rid)
+                    return result
+                # SDC: this replica's host produced bytes its own
+                # verifier liked but ours rejects — quarantine, stop
+                # trusting the host for this job, re-prove elsewhere
+                self._quarantine_result(result)
+                br.record(False)
+                self._release(digest, rid, "sdc")
+                self._exclude(digest, rid)
+                tried.add(rid)
+                self.health.incr("dispatcher_sdc_rerouted")
+                if sdc_from is not None:
+                    # two hosts produced unverifiable proofs: that's not
+                    # an SDC, the job is bad — same terminal error as the
+                    # single-host path
+                    from .selfverify import ProofVerifyFailed, proof_kind
+                    raise ProofVerifyFailed(proof_kind(method))
+                sdc_from = rid
+                continue
+
+            br.record(False)
+            self.health.incr("dispatcher_replica_failures")
+            self._release(digest, rid, outcome)
+            if outcome == "error" and not _is_infra_error(exc):
+                raise exc       # deterministic prover error: unchanged
+            self._exclude(digest, rid)
+            tried.add(rid)
+            last_exc = exc
+            lease_failed = True     # next grant is a takeover
+
+    def _quarantine_result(self, result):
+        store = getattr(getattr(self._queue, "store", None),
+                        "quarantine_bytes", None)
+        if store is None:
+            return
+        try:
+            from .selfverify import decode_result
+            proof, _ = decode_result(result)
+            store(proof, suffix=".proof")
+        except Exception:
+            pass    # quarantine is best-effort; the reroute is the fix
+
+    # -- introspection -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Per-replica state for /healthz and the Prometheus gauges."""
+        with self._lock:
+            reps = []
+            for r in self.replicas:
+                rid = r.replica_id
+                cached = self._health_cache.get(rid)
+                st = self._stats.get(rid, {"dispatched": 0, "failures": 0})
+                reps.append({
+                    "replica_id": rid,
+                    "breaker": self._breakers[rid].snapshot(),
+                    "healthy": None if cached is None else bool(cached[1]),
+                    "active_leases": sum(
+                        1 for v in self._active.values() if v == rid),
+                    "dispatched": st["dispatched"],
+                    "failures": st["failures"],
+                })
+            return {"replicas": reps, "lease_s": self.lease_s,
+                    "active_leases": len(self._active),
+                    "excluded_digests": len(self._excluded)}

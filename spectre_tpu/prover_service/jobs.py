@@ -1061,4 +1061,8 @@ def ensure_jobs(state, journal_dir: str | None = None, runner=None,
         else getattr(state, "params_dir", None),
         default_timeout=default_timeout, **queue_kw)
     state.jobs = jobsq
+    # proof farm (ISSUE 11): a Dispatcher runner gets the queue handed
+    # back so SDC quarantine can reach the queue's artifact store
+    if hasattr(runner, "attach_queue"):
+        runner.attach_queue(jobsq)
     return jobsq

@@ -51,6 +51,7 @@ Beyond the reference (PR 3, resilient service):
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -160,11 +161,22 @@ class _Handler(BaseHTTPRequestHandler):
     state: ProverState = None  # class attrs injected by serve()
     jobs = None
     follower = None            # optional: the light-client follower daemon
+    dispatcher = None          # optional: proof-farm dispatcher (ISSUE 11)
+    replica_id = None          # this server's id within a farm
 
     def log_message(self, fmt, *args):  # quiet by default
         pass
 
     def _reply(self, resp: dict, status: int = 200, headers: dict = None):
+        # farm debuggability (ISSUE 11): every RPC error names the
+        # serving replica, so a client retrying across endpoints can say
+        # WHICH box failed (rpc_client surfaces it as RpcError.replica_id)
+        if self.replica_id is not None and isinstance(resp, dict) \
+                and isinstance(resp.get("error"), dict):
+            resp["error"].setdefault("data", {})
+            if isinstance(resp["error"]["data"], dict):
+                resp["error"]["data"].setdefault("replica_id",
+                                                 self.replica_id)
         body = json.dumps(resp).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
@@ -204,6 +216,8 @@ class _Handler(BaseHTTPRequestHandler):
         sc = getattr(self.state, "self_check", None)
         if sc is not None:
             snap["self_check"] = sc.snapshot()
+        if self.dispatcher is not None:
+            snap["dispatcher"] = self.dispatcher.snapshot()
         if any(b["state"] == "open" for b in breakers) \
                 or (sc is not None and not snap["self_check"]["ok"]):
             snap["status"] = "degraded"
@@ -384,6 +398,8 @@ class _Handler(BaseHTTPRequestHandler):
             sc = getattr(self.state, "self_check", None)
             if sc is not None:
                 result["self_check"] = sc.snapshot()
+            if self.dispatcher is not None:
+                result["dispatcher"] = self.dispatcher.snapshot()
         elif method == "ping":
             result = "pong"
         else:
@@ -393,18 +409,27 @@ class _Handler(BaseHTTPRequestHandler):
 
 def serve(state: ProverState, host: str = "127.0.0.1", port: int = 3000,
           background: bool = False, journal_dir: str | None = None,
-          job_timeout: float | None = None, follower=None, **queue_kw):
+          job_timeout: float | None = None, follower=None, dispatcher=None,
+          replica_id: str | None = None, **queue_kw):
     """`journal_dir` defaults to the state's params_dir (when set) — pass
     explicitly to place the crash-safe job journal elsewhere; `job_timeout`
     is the default per-job deadline for async submissions. `follower`
     (optional) enables the getLightClientUpdate / getUpdateRange /
-    followerStatus serving methods. Extra `queue_kw` (queue_depth,
-    mem_watermark_mb, stall_timeout, ...) reach the JobQueue's
-    admission/supervision layer."""
+    followerStatus serving methods. `dispatcher` (optional, ISSUE 11)
+    replaces the local-state queue runner with a proof-farm Dispatcher —
+    the queue, dedup and journal are unchanged; only WHERE proofs run
+    moves. `replica_id` (default $SPECTRE_REPLICA_ID) names this server
+    in a farm: it is stamped into every RPC error's data. Extra
+    `queue_kw` (queue_depth, mem_watermark_mb, stall_timeout, ...) reach
+    the JobQueue's admission/supervision layer."""
     _Handler.state = state
     _Handler.jobs = ensure_jobs(state, journal_dir=journal_dir,
-                                default_timeout=job_timeout, **queue_kw)
+                                default_timeout=job_timeout,
+                                runner=dispatcher, **queue_kw)
     _Handler.follower = follower
+    _Handler.dispatcher = dispatcher
+    _Handler.replica_id = replica_id if replica_id is not None \
+        else (os.environ.get("SPECTRE_REPLICA_ID") or None)
     server = ThreadingHTTPServer((host, port), _Handler)
     if background:
         t = threading.Thread(target=server.serve_forever, daemon=True)

@@ -39,14 +39,18 @@ from .rpc import (RPC_METHOD_COMMITTEE, RPC_METHOD_COMMITTEE_SUBMIT,
 class RpcError(RuntimeError):
     """A JSON-RPC error response (code + message, as sent by the server).
     `retry_after` carries the server's backoff hint (seconds) on a
-    `-32001 service overloaded` shed, else None."""
+    `-32001 service overloaded` shed, else None. `replica_id` names the
+    farm replica that served the error (ISSUE 11; None outside a farm)."""
 
     def __init__(self, code: int, message: str,
-                 retry_after: float | None = None):
-        super().__init__(f"rpc error {code}: {message}")
+                 retry_after: float | None = None,
+                 replica_id: str | None = None):
+        super().__init__(f"rpc error {code}: {message}"
+                         + (f" [replica {replica_id}]" if replica_id else ""))
         self.code = code
         self.message = message
         self.retry_after = retry_after
+        self.replica_id = replica_id
 
 
 def _is_conn_reset(exc: BaseException) -> bool:
@@ -58,11 +62,19 @@ def _is_conn_reset(exc: BaseException) -> bool:
 
 
 class ProverClient:
-    def __init__(self, url: str, timeout: float = 3600.0,
+    def __init__(self, url, timeout: float = 3600.0,
                  conn_retries: int = 1, overload_retries: int = 2,
                  retry_after_cap: float = 30.0,
                  sleep=time.sleep, rng=random.random, clock=time.time):
-        self.url = url
+        """`url` is one endpoint or a list of them (ISSUE 11: a proof
+        farm has many frontends). Calls go to the current endpoint; a
+        connection-reset retry ROTATES to the next one first, so the
+        retry lands on a different replica instead of hammering the one
+        that just dropped the connection."""
+        self.urls = [url] if isinstance(url, str) else list(url)
+        if not self.urls:
+            raise ValueError("ProverClient needs at least one URL")
+        self._url_index = 0
         self.timeout = timeout
         self.conn_retries = conn_retries
         self.overload_retries = overload_retries
@@ -71,6 +83,20 @@ class ProverClient:
         self._rng = rng
         self._clock = clock
         self._id = 0
+
+    @property
+    def url(self) -> str:
+        """Current endpoint (rotates on connection-reset retries)."""
+        return self.urls[self._url_index % len(self.urls)]
+
+    @url.setter
+    def url(self, value: str):
+        self.urls = [value]
+        self._url_index = 0
+
+    def _rotate_url(self):
+        if len(self.urls) > 1:
+            self._url_index = (self._url_index + 1) % len(self.urls)
 
     def _raise_rpc_error(self, data: dict, headers=None):
         err = (data or {}).get("error") or {}
@@ -82,9 +108,12 @@ class ProverClient:
                     retry_after = float(headers.get("Retry-After"))
                 except (TypeError, ValueError):
                     pass
+        data_field = err.get("data")
+        replica_id = data_field.get("replica_id") \
+            if isinstance(data_field, dict) else None
         raise RpcError(err.get("code", -32603),
                        err.get("message", "unknown error"),
-                       retry_after=retry_after)
+                       retry_after=retry_after, replica_id=replica_id)
 
     def _call(self, method: str, params: dict, timeout: float | None = None):
         self._id += 1
@@ -112,6 +141,10 @@ class ProverClient:
                 raise
             except Exception as exc:
                 if _is_conn_reset(exc) and attempt < self.conn_retries:
+                    # farm-aware retry (ISSUE 11): prefer a DIFFERENT
+                    # replica — the endpoint that reset us is the one
+                    # most likely mid-restart
+                    self._rotate_url()
                     attempt += 1
                     continue
                 raise

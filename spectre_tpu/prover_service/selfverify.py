@@ -182,6 +182,57 @@ def verified_prove(state, kind: str, args, heartbeat=None, health=HEALTH):
     raise ProofVerifyFailed(kind)
 
 
+# -- cross-host verification (ISSUE 11: proof farm) -------------------------
+
+def proof_kind(method: str) -> str:
+    """Map an RPC prove method to its verifying-key kind."""
+    return "committee" if "Committee" in method else "step"
+
+
+def decode_result(result: dict) -> tuple[bytes, list[int]]:
+    """Decode a queue-runner result dict back into (proof, instances) —
+    the inverse of run_proof_method's hex encoding."""
+    proof = bytes.fromhex(result["proof"].removeprefix("0x"))
+    instances = [int(h, 16) for h in result["instances"]]
+    return proof, instances
+
+
+def cross_verify(verify_state, method: str, result, health=HEALTH) -> bool:
+    """Re-verify a proof produced by ANOTHER host, on this host's keys.
+
+    The PR-9 SDC retry reuses the producing host's own CPU — a bad DIMM
+    hits both paths. The dispatcher calls this on every replica result
+    so corruption is caught by hardware the suspect host never touched.
+    Returns True when the proof verifies (or verification is skipped:
+    policy ``off``, sampled-out, no verifier on this state, or a result
+    shape that isn't a proof); False means suspected SDC — the caller
+    quarantines and re-dispatches to a different replica."""
+    if (verify_state is None
+            or not hasattr(verify_state, "verify_proof")
+            or not isinstance(result, dict) or "proof" not in result):
+        return True
+    mode, p = policy()
+    if mode == "off" or (mode == "sampled" and RNG() >= p):
+        return True
+    kind = proof_kind(method)
+    try:
+        proof, instances = decode_result(result)
+    except (KeyError, ValueError):
+        return True         # not a proof-shaped result; nothing to verify
+    with phase("prove/cross_verify"):
+        try:
+            ok = bool(verify_state.verify_proof(kind, proof, instances))
+        except Exception as exc:
+            tracing.annotate(cross_verify_error=f"{type(exc).__name__}")
+            ok = False
+    if ok:
+        health.incr("proofs_cross_verified")
+    else:
+        health.incr("proofs_cross_verify_failed")
+        obs_manifest.record_event("cross_verify_failed", proof_kind=kind)
+    return ok
+
+
 # -- readiness self-check ---------------------------------------------------
 
 @functools.lru_cache(maxsize=1)

@@ -87,6 +87,20 @@ SITES = {
                          "verified-update-store journal append (the "
                          "follower chain record behind each stored "
                          "light-client update)"),
+    "replica.dispatch": ("prover_service/dispatcher.py",
+                         "replica-side prove entry under a dispatcher "
+                         "lease (kind `crash` kills the replica "
+                         "mid-prove: the lease dies unrenewed and the "
+                         "job moves to a surviving replica)"),
+    "replica.health": ("prover_service/dispatcher.py",
+                       "replica health probe during dispatch routing "
+                       "(a failing probe marks the replica unhealthy; "
+                       "it is skipped, not crashed)"),
+    "replica.lease": ("prover_service/dispatcher.py",
+                      "lease-journal append, AFTER the record lands "
+                      "(the post-append crash window restart replay "
+                      "must cover; `ioerror` is tolerated — counted on "
+                      "dispatcher_lease_journal_failures)"),
 }
 
 

@@ -2,10 +2,13 @@
 
 Every graceful-degradation path in the service (beacon retry/backoff,
 circuit-breaker transitions, device-prove CPU fallback, fixed-base MSM
-table-budget degrade, job-queue dedup/requeue) increments a named counter
-here instead of logging and forgetting. The prover service surfaces the
-snapshot via the `health` RPC method and GET /healthz; ROADMAP records the
-counters as the hook for future metrics export (Prometheus et al.).
+table-budget degrade, job-queue dedup/requeue, proof-farm dispatch:
+`dispatcher_*` lease takeovers/breaker skips/SDC reroutes and
+`beacon_quorum_*` dissent counting) increments a named counter here
+instead of logging and forgetting. The prover service surfaces the
+snapshot via the `health` RPC method and GET /healthz, and every counter
+exports as `spectre_<name>_total` in /metrics — new counters need zero
+exporter changes.
 
 Dependency-free on purpose: ops/ kernels and the preprocessor increment
 counters without pulling in the service layer.

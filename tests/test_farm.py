@@ -1,0 +1,737 @@
+"""Proof-farm failover matrix (ISSUE 11, tests/test_farm.py).
+
+The dispatcher tier: replica crash mid-prove -> lease takeover with a
+byte-identical proof, breaker-open replica receives no work, SDC
+re-prove on a DIFFERENT replica (cross-host verification), dispatcher
+restart replays leases without double-proving, lease expiry on a
+stalled replica, beacon quorum ignores a lone dissenting head, and the
+UpdateStore 10k-period RSS bound. Seconds-scale: every replica is an
+in-process :class:`LocalReplica` with a canned runner, clocks are
+injectable, and fault plans come from spectre_tpu.utils.faults.
+
+Runs in the default tier, via `make test-faults` and `make test-farm`.
+"""
+
+import hashlib
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import tracemalloc
+import urllib.request
+
+import pytest
+
+from spectre_tpu.observability import manifest as obs_manifest
+from spectre_tpu.prover_service.dispatcher import (Dispatcher, HttpReplica,
+                                                   LocalReplica,
+                                                   NoReplicaAvailable)
+from spectre_tpu.prover_service.jobs import JobQueue, witness_digest
+from spectre_tpu.utils import faults
+from spectre_tpu.utils.breaker import BreakerOpen, CircuitBreaker
+from spectre_tpu.utils.health import HEALTH, ServiceHealth
+
+METHOD = "genEvmProof_SyncStepCompressed"
+PROOF = bytes(range(64))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _result(proof: bytes = PROOF) -> dict:
+    return {"proof": "0x" + proof.hex(), "instances": ["0x7", "0x9"]}
+
+
+def _digest_of(result: dict) -> str:
+    return hashlib.sha256(json.dumps(result, sort_keys=True,
+                                     separators=(",", ":")).encode()
+                          ).hexdigest()
+
+
+def _mk_runner(calls: list, proof: bytes = PROOF, mangle_site=None):
+    """Canned queue-runner: records calls, returns a deterministic
+    result (optionally passing the proof bytes through a mangle site —
+    the SDC stand-in)."""
+    def runner(method, params, heartbeat=None):
+        calls.append(method)
+        p = faults.mangle(mangle_site, proof) if mangle_site else proof
+        return _result(p)
+    return runner
+
+
+def _ranked_ids(ids, method=METHOD, params=None):
+    """Replica ids in the dispatcher's rendezvous order for a digest —
+    so tests can pin WHICH replica is tried first."""
+    digest = witness_digest(method, params if params is not None else {})
+    return sorted(ids, key=lambda rid: hashlib.sha256(
+        f"{digest}|{rid}".encode()).hexdigest())
+
+
+class _VerifyState:
+    """Cross-host verifier: accepts exactly the canned PROOF bytes."""
+
+    def __init__(self, proof: bytes = PROOF):
+        self._proof = proof
+        self.calls = 0
+
+    def verify_proof(self, kind, proof, instances):
+        self.calls += 1
+        return proof == self._proof
+
+
+# -- circuit breaker unit (shared beacon/dispatcher machinery) --------------
+
+
+class TestCircuitBreaker:
+    def test_full_state_machine_with_fake_clock(self):
+        clk = [0.0]
+        h = ServiceHealth()
+        br = CircuitBreaker(threshold=2, cooldown=10.0, health=h,
+                            counter_prefix="t", clock=lambda: clk[0])
+        assert br.state == "closed"
+        br.admit()
+        br.record(False)
+        assert br.state == "closed"
+        br.record(False)                      # threshold -> OPEN + trip
+        assert br.state == "open"
+        assert h.get("t_trips") == 1
+        with pytest.raises(BreakerOpen):
+            br.admit()                        # fails fast while open
+        assert 0.0 < br.remaining() <= 10.0
+        clk[0] = 10.0                         # cooldown over -> half-open
+        assert br.state == "half-open"
+        br.admit()                            # the one trial admission
+        assert h.get("t_half_open") == 1
+        br.record(False)                      # failed trial -> re-open
+        assert br.state == "open"
+        assert h.get("t_trips") == 2
+        clk[0] = 20.0
+        br.admit()
+        br.record(True)                       # successful trial -> closed
+        assert br.state == "closed"
+        assert br.consecutive_failures == 0
+        assert br.snapshot() == {"state": "closed", "state_code": 0,
+                                 "consecutive_failures": 0}
+
+
+# -- routing ----------------------------------------------------------------
+
+
+class TestRouting:
+    def test_same_witness_prefers_same_replica(self, tmp_path):
+        calls = {"a": [], "b": [], "c": []}
+        d = Dispatcher([LocalReplica(r, runner=_mk_runner(calls[r]))
+                        for r in calls], poll_s=0.005)
+        for _ in range(3):
+            assert d.dispatch(METHOD, {"w": 1}) == _result()
+        first = _ranked_ids(list(calls), params={"w": 1})[0]
+        assert len(calls[first]) == 3
+        assert all(not calls[r] for r in calls if r != first)
+
+    def test_breaker_open_replica_gets_no_work(self, tmp_path):
+        calls = {"a": [], "b": []}
+        d = Dispatcher([LocalReplica(r, runner=_mk_runner(calls[r]))
+                        for r in calls], poll_s=0.005, breaker_threshold=2,
+                       breaker_cooldown=60.0)
+        first, second = _ranked_ids(list(calls))
+        for _ in range(2):                    # trip the preferred replica
+            d.breaker(first).record(False)
+        assert d.breaker(first).state == "open"
+        skips0 = HEALTH.get("dispatcher_breaker_skips")
+        assert d.dispatch(METHOD, {}) == _result()
+        assert calls[first] == []             # open breaker: skipped
+        assert len(calls[second]) == 1
+        assert HEALTH.get("dispatcher_breaker_skips") == skips0 + 1
+
+    def test_failing_health_probe_skips_not_crashes(self, monkeypatch):
+        calls = {"a": [], "b": []}
+        d = Dispatcher([LocalReplica(r, runner=_mk_runner(calls[r]))
+                        for r in calls], poll_s=0.005)
+        first, second = _ranked_ids(list(calls))
+        un0 = HEALTH.get("dispatcher_replica_unhealthy")
+        # the probe fault fires once: the FIRST-ranked replica's probe
+        # blows up, it is skipped (not crashed), work lands on the other
+        monkeypatch.setenv("SPECTRE_FAULT_PLAN", "replica.health:raise:1")
+        assert d.dispatch(METHOD, {}) == _result()
+        assert calls[first] == [] and len(calls[second]) == 1
+        assert HEALTH.get("dispatcher_replica_unhealthy") == un0 + 1
+        snap = {r["replica_id"]: r for r in d.snapshot()["replicas"]}
+        assert snap[first]["healthy"] is False
+        assert snap[second]["healthy"] is True
+
+    def test_no_replica_available(self):
+        d = Dispatcher([], poll_s=0.005)
+        n0 = HEALTH.get("dispatcher_no_replica")
+        with pytest.raises(NoReplicaAvailable):
+            d.dispatch(METHOD, {})
+        assert HEALTH.get("dispatcher_no_replica") == n0 + 1
+
+    def test_capability_routing(self):
+        calls = {"step-only": [], "full": []}
+        d = Dispatcher([
+            LocalReplica("step-only", runner=_mk_runner(calls["step-only"]),
+                         capabilities={METHOD}),
+            LocalReplica("full", runner=_mk_runner(calls["full"]))],
+            poll_s=0.005)
+        d.dispatch("genEvmProof_CommitteeUpdateCompressed", {})
+        assert calls["step-only"] == []       # can't serve committee
+        assert len(calls["full"]) == 1
+
+    def test_duplicate_replica_id_rejected(self):
+        d = Dispatcher([LocalReplica("a", runner=_mk_runner([]))])
+        with pytest.raises(ValueError, match="duplicate replica id"):
+            d.register(LocalReplica("a", runner=_mk_runner([])))
+
+    def test_deterministic_prover_error_not_failed_over(self):
+        """Witness rejection is the JOB's fault, not the replica's: it
+        re-raises unchanged instead of burning the other replicas."""
+        calls_b = []
+
+        def bad_witness(method, params, heartbeat=None):
+            raise AssertionError("finality branch mismatch")
+
+        ids = _ranked_ids(["a", "b"])
+        runners = {ids[0]: bad_witness, ids[1]: _mk_runner(calls_b)}
+        d = Dispatcher([LocalReplica(r, runner=runners[r]) for r in ids],
+                       poll_s=0.005)
+        with pytest.raises(AssertionError, match="finality branch"):
+            d.dispatch(METHOD, {})
+        assert calls_b == []                  # no failover for bad input
+
+
+# -- the acceptance drill: crash mid-prove -> lease takeover ----------------
+
+
+class TestFailoverDrill:
+    def test_replica_crash_byte_identical_takeover(self, tmp_path,
+                                                   monkeypatch):
+        """ISSUE-11 acceptance: SPECTRE_FAULT_PLAN=replica.dispatch:crash:1
+        against 3 in-process replicas — the job completes on a surviving
+        replica, the result digest is byte-identical to a clean
+        single-replica prove, dispatcher_lease_takeovers ticks once."""
+        # clean single-replica reference prove first (no faults armed)
+        ref = Dispatcher([LocalReplica("solo", runner=_mk_runner([]))],
+                         poll_s=0.005)
+        ref_digest = _digest_of(ref.dispatch(METHOD, {"w": "drill"}))
+
+        calls = {"r1": [], "r2": [], "r3": []}
+        d = Dispatcher([LocalReplica(r, runner=_mk_runner(calls[r]))
+                        for r in calls],
+                       journal_dir=str(tmp_path), lease_s=30.0, poll_s=0.005)
+        take0 = HEALTH.get("dispatcher_lease_takeovers")
+        fail0 = HEALTH.get("dispatcher_replica_failures")
+        monkeypatch.setenv("SPECTRE_FAULT_PLAN", "replica.dispatch:crash:1")
+        result = d.dispatch(METHOD, {"w": "drill"})
+        assert _digest_of(result) == ref_digest     # byte-identical
+        assert faults.fired_count("replica.dispatch") == 1
+        assert HEALTH.get("dispatcher_lease_takeovers") == take0 + 1
+        assert HEALTH.get("dispatcher_replica_failures") == fail0 + 1
+        # the crash killed the first-ranked replica BEFORE its runner ran;
+        # exactly one surviving replica proved
+        first, second, _ = _ranked_ids(list(calls), params={"w": "drill"})
+        assert calls[first] == []
+        assert len(calls[second]) == 1
+        assert sum(len(c) for c in calls.values()) == 1
+        # the lease journal tells the story: crashed grant, takeover
+        # grant, done release
+        recs = [json.loads(line) for line in
+                (tmp_path / "dispatcher.leases.jsonl").read_text()
+                .splitlines()]
+        events = [(r["event"], r.get("outcome")) for r in recs]
+        assert events == [("lease", None), ("release", "crashed"),
+                          ("lease", None), ("release", "done")]
+        assert recs[0]["replica"] == first
+        assert recs[2]["replica"] == second and recs[2]["takeover"] is True
+
+    def test_manifest_records_both_replicas(self, monkeypatch):
+        calls = {"a": [], "b": []}
+        d = Dispatcher([LocalReplica(r, runner=_mk_runner(calls[r]))
+                        for r in calls], poll_s=0.005)
+        monkeypatch.setenv("SPECTRE_FAULT_PLAN", "replica.dispatch:crash:1")
+        with obs_manifest.collect_events() as events:
+            d.dispatch(METHOD, {})
+        leases = [e for e in events if e["kind"] == "replica_lease"]
+        assert [e["takeover"] for e in leases] == [False, True]
+        assert leases[0]["replica"] != leases[1]["replica"]
+
+    def test_lease_journal_ioerror_tolerated(self, tmp_path, monkeypatch):
+        """`replica.lease:ioerror` (disk trouble on the lease journal)
+        must not fail the prove — counted, farm keeps going."""
+        d = Dispatcher([LocalReplica("a", runner=_mk_runner([]))],
+                       journal_dir=str(tmp_path), poll_s=0.005)
+        j0 = HEALTH.get("dispatcher_lease_journal_failures")
+        monkeypatch.setenv("SPECTRE_FAULT_PLAN", "replica.lease:ioerror:1")
+        assert d.dispatch(METHOD, {}) == _result()
+        assert HEALTH.get("dispatcher_lease_journal_failures") == j0 + 1
+
+
+# -- lease expiry on a stalled (not crashed) replica ------------------------
+
+
+class TestLeaseExpiry:
+    def test_stalled_replica_lease_expires_and_job_moves(self):
+        clk = [0.0]
+        release = threading.Event()
+        ids = _ranked_ids(["stall", "live"])
+        calls_live = []
+
+        def stalling(method, params, heartbeat=None):
+            clk[0] += 1000.0          # way past the lease, never renewing
+            release.wait(10.0)        # disowned thread parks here
+
+        runners = {"stall": stalling, "live": _mk_runner(calls_live)}
+        # make the STALLED replica the rendezvous favourite
+        d = Dispatcher([LocalReplica(ids[0], runner=runners["stall"]),
+                        LocalReplica(ids[1], runner=runners["live"])],
+                       lease_s=60.0, poll_s=0.005, clock=lambda: clk[0])
+        exp0 = HEALTH.get("dispatcher_lease_expired")
+        take0 = HEALTH.get("dispatcher_lease_takeovers")
+        try:
+            assert d.dispatch(METHOD, {}) == _result()
+        finally:
+            release.set()
+        assert HEALTH.get("dispatcher_lease_expired") == exp0 + 1
+        assert HEALTH.get("dispatcher_lease_takeovers") == take0 + 1
+        assert len(calls_live) == 1
+
+    def test_heartbeat_renews_lease(self):
+        """A slow-but-renewing replica keeps its lease: the runner's
+        heartbeat resets expiry, so a prove longer than lease_s still
+        completes on the SAME replica."""
+        clk = [0.0]
+        calls = []
+
+        def slow(method, params, heartbeat=None):
+            for _ in range(5):
+                clk[0] += 40.0        # 200s of "work" under a 60s lease
+                heartbeat()
+            calls.append(method)
+            return _result()
+
+        d = Dispatcher([LocalReplica("slow", runner=slow)],
+                       lease_s=60.0, poll_s=0.005, clock=lambda: clk[0])
+        exp0 = HEALTH.get("dispatcher_lease_expired")
+        assert d.dispatch(METHOD, {}) == _result()
+        assert len(calls) == 1
+        assert HEALTH.get("dispatcher_lease_expired") == exp0
+
+
+# -- SDC: cross-host verification reroutes to a different replica -----------
+
+
+class TestSdcReroute:
+    def _farm(self, tmp_path=None, verify=None):
+        ids = _ranked_ids(["a", "b"])
+        calls = {rid: [] for rid in ids}
+        # the rendezvous favourite passes its proof through the SDC
+        # mangle site; the other returns clean bytes
+        reps = [LocalReplica(ids[0], runner=_mk_runner(
+                    calls[ids[0]], mangle_site="proof.bytes")),
+                LocalReplica(ids[1], runner=_mk_runner(calls[ids[1]]))]
+        d = Dispatcher(reps, poll_s=0.005,
+                       journal_dir=str(tmp_path) if tmp_path else None,
+                       verify_state=verify or _VerifyState())
+        return d, ids, calls
+
+    def test_sdc_reproved_on_different_replica(self, tmp_path, monkeypatch):
+        # an earlier bench run may have left SPECTRE_SELF_VERIFY=off in
+        # the process env; cross-verification honors the same policy knob
+        monkeypatch.setenv("SPECTRE_SELF_VERIFY", "always")
+        d, ids, calls = self._farm(tmp_path)
+        sdc0 = HEALTH.get("dispatcher_sdc_rerouted")
+        xf0 = HEALTH.get("proofs_cross_verify_failed")
+        xok0 = HEALTH.get("proofs_cross_verified")
+        monkeypatch.setenv("SPECTRE_FAULT_PLAN", "proof.bytes:corrupt:1")
+        with obs_manifest.collect_events() as events:
+            result = d.dispatch(METHOD, {})
+        assert result == _result()            # the CLEAN bytes are served
+        assert len(calls[ids[0]]) == 1 and len(calls[ids[1]]) == 1
+        assert HEALTH.get("dispatcher_sdc_rerouted") == sdc0 + 1
+        assert HEALTH.get("proofs_cross_verify_failed") == xf0 + 1
+        assert HEALTH.get("proofs_cross_verified") == xok0 + 1
+        # manifest pins BOTH hosts: the corrupting one and the fixer
+        reroute = [e for e in events if e["kind"] == "sdc_reroute"]
+        assert reroute == [{"kind": "sdc_reroute",
+                            "from_replica": ids[0], "to_replica": ids[1]}]
+        leases = [e["replica"] for e in events
+                  if e["kind"] == "replica_lease"]
+        assert leases == [ids[0], ids[1]]
+
+    def test_double_sdc_fails_job(self, monkeypatch):
+        from spectre_tpu.prover_service.selfverify import ProofVerifyFailed
+        monkeypatch.setenv("SPECTRE_SELF_VERIFY", "always")
+        ids = _ranked_ids(["a", "b"])
+        calls = {rid: [] for rid in ids}
+        d = Dispatcher([LocalReplica(r, runner=_mk_runner(
+                            calls[r], mangle_site="proof.bytes"))
+                        for r in ids],
+                       poll_s=0.005, verify_state=_VerifyState())
+        monkeypatch.setenv("SPECTRE_FAULT_PLAN", "proof.bytes:corrupt:2")
+        with pytest.raises(ProofVerifyFailed):
+            d.dispatch(METHOD, {})
+        # both replicas produced unverifiable bytes -> terminal, same
+        # error class as the single-host verify-before-serve path
+        assert len(calls[ids[0]]) == 1 and len(calls[ids[1]]) == 1
+
+    def test_sdc_bytes_quarantined(self, tmp_path, monkeypatch):
+        from spectre_tpu.utils.artifacts import ArtifactStore
+        monkeypatch.setenv("SPECTRE_SELF_VERIFY", "always")
+        d, ids, calls = self._farm()
+        store = ArtifactStore(str(tmp_path))
+
+        class _Q:                              # queue façade: just a store
+            pass
+
+        q = _Q()
+        q.store = store
+        d.attach_queue(q)
+        monkeypatch.setenv("SPECTRE_FAULT_PLAN", "proof.bytes:corrupt:1")
+        d.dispatch(METHOD, {})
+        quarantined = os.listdir(store.quarantine_dir)
+        assert len(quarantined) == 1
+        assert quarantined[0].endswith(".proof")
+        with open(os.path.join(store.quarantine_dir, quarantined[0]),
+                  "rb") as f:
+            bad = f.read()
+        assert bad != PROOF                    # the CORRUPT bytes, parked
+
+
+# -- restart: lease journal replay ------------------------------------------
+
+
+class TestLeaseReplay:
+    def test_restart_replays_open_lease_and_reroutes(self, tmp_path,
+                                                     monkeypatch):
+        """Dispatcher dies right after journaling a lease grant (the
+        post-append crash window): the restarted dispatcher must not
+        re-trust the replica that died holding the lease, and the
+        queue's dedup must not double-prove."""
+        qdir, ddir = str(tmp_path / "q"), str(tmp_path / "d")
+        ids = _ranked_ids(["a", "b"], params={"w": 1})
+        calls1 = {rid: [] for rid in ids}
+        d1 = Dispatcher([LocalReplica(r, runner=_mk_runner(calls1[r]))
+                         for r in ids], journal_dir=ddir, poll_s=0.005)
+        q1 = JobQueue(d1, concurrency=1, journal_dir=qdir)
+        monkeypatch.setenv("SPECTRE_FAULT_PLAN", "replica.lease:crash:1")
+        # the InjectedCrash kills the worker thread like a dead process;
+        # silence the default excepthook traceback spam
+        old_hook = threading.excepthook
+        threading.excepthook = lambda args: None
+        try:
+            jid = q1.submit(METHOD, {"w": 1})
+            deadline = time.time() + 10
+            while faults.fired_count("replica.lease") < 1:
+                assert time.time() < deadline, "lease crash never fired"
+                time.sleep(0.01)
+            deadline = time.time() + 10
+            while any(w.is_alive() for w in q1._workers):
+                assert time.time() < deadline, "worker did not die"
+                time.sleep(0.01)
+        finally:
+            threading.excepthook = old_hook
+        assert q1.status(jid)["status"] == "running"   # crashed mid-job
+        assert not calls1[ids[0]] and not calls1[ids[1]]
+        q1.stop()
+
+        monkeypatch.delenv("SPECTRE_FAULT_PLAN")
+        faults.clear()                        # disarm for the restart
+        rep0 = HEALTH.get("dispatcher_leases_replayed")
+        take0 = HEALTH.get("dispatcher_lease_takeovers")
+        calls2 = {rid: [] for rid in ids}
+        d2 = Dispatcher([LocalReplica(r, runner=_mk_runner(calls2[r]))
+                         for r in ids], journal_dir=ddir, poll_s=0.005)
+        assert HEALTH.get("dispatcher_leases_replayed") == rep0 + 1
+        q2 = JobQueue(d2, concurrency=1, journal_dir=qdir)
+        try:
+            job = q2.wait(jid, timeout=10)    # recovery requeued it
+            assert job.status == "done"
+            assert job.result == _result()
+            # the dead-lease replica is excluded: the OTHER one proved
+            assert calls2[ids[0]] == []
+            assert len(calls2[ids[1]]) == 1
+            assert HEALTH.get("dispatcher_lease_takeovers") == take0 + 1
+            # resubmitting the same witness is a dedup cache hit
+            assert q2.submit(METHOD, {"w": 1}) == jid
+            assert sum(len(c) for c in calls2.values()) == 1
+        finally:
+            q2.stop()
+
+    def test_replay_skips_torn_tail_and_done_leases(self, tmp_path):
+        ddir = str(tmp_path)
+        d1 = Dispatcher([LocalReplica("a", runner=_mk_runner([]))],
+                        journal_dir=ddir, poll_s=0.005)
+        d1.dispatch(METHOD, {"w": 1})         # grant + done release
+        path = os.path.join(ddir, "dispatcher.leases.jsonl")
+        with open(path, "a") as f:
+            f.write('{"event": "lease", "digest": "tor')   # torn append
+        rep0 = HEALTH.get("dispatcher_leases_replayed")
+        d2 = Dispatcher([LocalReplica("a", runner=_mk_runner([]))],
+                        journal_dir=ddir, poll_s=0.005)
+        # the done lease is NOT an exclusion and the torn line is skipped
+        assert HEALTH.get("dispatcher_leases_replayed") == rep0
+        assert d2.dispatch(METHOD, {"w": 1}) == _result()
+
+
+# -- multi-beacon quorum ----------------------------------------------------
+
+
+class _StubBeacon:
+    def __init__(self, head_root, breaker_state="closed", error=None):
+        self._head = head_root
+        self.breaker_state = breaker_state
+        self._error = error
+        self.demoted = 0
+        self.polls = 0
+
+    def finality_update(self):
+        self.polls += 1
+        if self._error is not None:
+            raise self._error
+        return {"finalized_header": {"slot": 64, "root": self._head},
+                "signature_slot": 66}
+
+    def demote(self):
+        self.demoted += 1
+
+
+class TestBeaconQuorum:
+    def _quorum(self, *clients, quorum=2):
+        from spectre_tpu.preprocessor.beacon import BeaconQuorum
+        return BeaconQuorum(list(clients), quorum=quorum)
+
+    def test_dissenting_beacon_ignored_and_demoted(self):
+        """ISSUE-11 acceptance: 2-of-3 agree on the finalized head; the
+        lone divergent beacon is outvoted and demoted."""
+        a, b = _StubBeacon("0xaa"), _StubBeacon("0xaa")
+        liar = _StubBeacon("0xff")
+        dis0 = HEALTH.get("beacon_quorum_dissent")
+        upd = self._quorum(a, b, liar).finality_update()
+        assert upd["finalized_header"]["root"] == "0xaa"
+        assert liar.demoted == 1 and a.demoted == 0 and b.demoted == 0
+        assert HEALTH.get("beacon_quorum_dissent") == dis0 + 1
+
+    def test_no_quorum_raises(self):
+        from spectre_tpu.preprocessor.beacon import QuorumNotReached
+        f0 = HEALTH.get("beacon_quorum_failures")
+        q = self._quorum(_StubBeacon("0xaa"), _StubBeacon("0xbb"),
+                         _StubBeacon("0xcc"))
+        with pytest.raises(QuorumNotReached, match="split"):
+            q.finality_update()
+        assert HEALTH.get("beacon_quorum_failures") == f0 + 1
+
+    def test_erroring_beacon_tolerated(self):
+        e0 = HEALTH.get("beacon_quorum_errors")
+        upd = self._quorum(_StubBeacon("0xaa"), _StubBeacon("0xaa"),
+                           _StubBeacon(None, error=TimeoutError("down"))
+                           ).finality_update()
+        assert upd["finalized_header"]["root"] == "0xaa"
+        assert HEALTH.get("beacon_quorum_errors") == e0 + 1
+
+    def test_breaker_open_beacon_skipped(self):
+        parked = _StubBeacon("0xff", breaker_state="open")
+        upd = self._quorum(_StubBeacon("0xaa"), _StubBeacon("0xaa"),
+                           parked).finality_update()
+        assert upd["finalized_header"]["root"] == "0xaa"
+        assert parked.polls == 0              # never even polled
+
+    def test_quorum_clamped_to_pool_size(self):
+        q = self._quorum(_StubBeacon("0xaa"), quorum=5)
+        assert q.quorum == 1
+        assert q.finality_update()["finalized_header"]["root"] == "0xaa"
+
+    def test_needs_clients(self):
+        from spectre_tpu.preprocessor.beacon import BeaconQuorum
+        with pytest.raises(ValueError):
+            BeaconQuorum([])
+
+    def test_persistent_dissenter_trips_own_breaker(self):
+        """demote() rides the real breaker: a beacon outvoted
+        `threshold` times in a row drops out of the pool entirely."""
+        from spectre_tpu.preprocessor.beacon import BeaconClient
+        bc = BeaconClient("http://127.0.0.1:9", breaker_threshold=2,
+                          breaker_cooldown=60.0)
+        assert bc.breaker_state == "closed"
+        bc.demote()
+        bc.demote()
+        assert bc.breaker_state == "open"
+
+
+# -- UpdateStore memory bound (10k-period backfill) -------------------------
+
+
+class TestUpdateStoreBound:
+    def test_10k_period_backfill_fits_lru_budget(self, tmp_path):
+        """A mainnet-scale backfill (10k committee periods) must replay
+        into a BOUNDED resident set: offsets+digests only, full records
+        LRU-capped, cache misses reloaded from the journal offset."""
+        from spectre_tpu.follower.updates import (UPDATE_SUFFIX, UpdateStore,
+                                                  _canonical)
+        from spectre_tpu.utils.artifacts import ArtifactStore
+
+        n, cap, probe = 10_000, 256, 1234
+        pos = lambda p: f"0x{p:x}"
+        art = ArtifactStore(str(tmp_path))
+        lines = []
+        for p in range(n):
+            result = {"proof": "0x01", "instances": ["0x1"],
+                      "committee_poseidon": pos(p)}
+            if p in (probe, n - 2, n - 1):
+                # only the records the test actually reads back (and the
+                # tip, which replay re-verifies) need real artifacts
+                digest = art.write(_canonical(result), UPDATE_SUFFIX)
+            else:
+                digest = f"{p:064x}"
+            lines.append(json.dumps(
+                {"kind": "committee", "period": p, "digest": digest,
+                 "committee_poseidon": pos(p),
+                 "prev_poseidon": pos(p - 1) if p else None},
+                sort_keys=True, separators=(",", ":")))
+        with open(tmp_path / "follower.updates.jsonl", "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+        ev0 = HEALTH.get("follower_update_cache_evictions")
+        tracemalloc.start()
+        try:
+            store = UpdateStore(str(tmp_path), cache_periods=cap)
+            _, peak = tracemalloc.get_traced_memory()
+        finally:
+            tracemalloc.stop()
+        assert peak < 64 * 2**20              # the fixed RSS budget pin
+        assert len(store._committee) == n     # every period indexed...
+        assert len(store._committee._lru) <= cap   # ...few resident
+        assert HEALTH.get("follower_update_cache_evictions") > ev0
+        assert store.tip_period() == n - 1
+        assert store.anchor_period() == 0
+        # a cold period reloads through its journal offset — record AND
+        # artifact round-trip
+        rec = store.get_committee(probe)
+        assert rec["result"]["committee_poseidon"] == pos(probe)
+        assert len(store._committee._lru) <= cap
+
+    def test_journal_name_matches_follower(self, tmp_path):
+        from spectre_tpu.follower import updates as U
+        assert U.JOURNAL_NAME == "follower.updates.jsonl"
+
+
+# -- farm-aware RPC plumbing ------------------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _ServeState:
+    """Bare state for serve(): the dispatcher replaces the runner, so no
+    prove methods are ever touched."""
+    concurrency = 1
+
+
+class TestFarmRpc:
+    def test_healthz_and_errors_carry_farm_identity(self, tmp_path):
+        """The full acceptance surface over HTTP: serve() with a
+        dispatcher -> prove lands on a replica, /healthz grows the
+        dispatcher section, RPC errors are stamped with the serving
+        replica id (RpcError.replica_id)."""
+        from spectre_tpu.prover_service.rpc import serve
+        from spectre_tpu.prover_service.rpc_client import (ProverClient,
+                                                           RpcError)
+        calls = []
+        d = Dispatcher([LocalReplica("farm-1", runner=_mk_runner(calls))],
+                       journal_dir=str(tmp_path), poll_s=0.005)
+        server = serve(_ServeState(), port=0, background=True,
+                       journal_dir=str(tmp_path), dispatcher=d,
+                       replica_id="head-1")
+        port = server.server_address[1]
+        try:
+            client = ProverClient(f"http://127.0.0.1:{port}", timeout=10)
+            assert client._call(METHOD, {"w": 1}) == _result()
+            assert len(calls) == 1            # the farm proved it
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5) as resp:
+                snap = json.load(resp)
+            reps = {r["replica_id"]: r
+                    for r in snap["dispatcher"]["replicas"]}
+            assert reps["farm-1"]["breaker"]["state"] == "closed"
+            assert reps["farm-1"]["dispatched"] == 1
+            assert snap["counters"]["dispatcher_jobs_dispatched"] >= 1
+            with pytest.raises(RpcError) as exc:
+                client.proof_status("no-such-job")
+            assert exc.value.code == -32004
+            assert exc.value.replica_id == "head-1"
+            assert "[replica head-1]" in str(exc.value)
+        finally:
+            server.shutdown()
+
+    def test_conn_reset_retry_rotates_endpoint(self, tmp_path):
+        """A client with several farm frontends retries a connection
+        reset against a DIFFERENT endpoint."""
+        from spectre_tpu.prover_service.rpc import serve
+        from spectre_tpu.prover_service.rpc_client import ProverClient
+        dead = f"http://127.0.0.1:{_free_port()}"
+        server = serve(_ServeState(), port=0, background=True,
+                       journal_dir=str(tmp_path))
+        live = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            client = ProverClient([dead, live], timeout=10, conn_retries=1,
+                                  sleep=lambda s: None)
+            assert client.ping() == "pong"    # refused -> rotate -> live
+            assert client.url == live
+        finally:
+            server.shutdown()
+
+    def test_single_url_client_unchanged(self):
+        from spectre_tpu.prover_service.rpc_client import ProverClient
+        c = ProverClient("http://127.0.0.1:1")
+        assert c.urls == ["http://127.0.0.1:1"]
+        assert c.url == "http://127.0.0.1:1"
+        with pytest.raises(ValueError):
+            ProverClient([])
+
+
+# -- hygiene pins -----------------------------------------------------------
+
+
+class TestFarmHygiene:
+    def test_dispatcher_importable_without_jax(self):
+        """prom.py imports dispatcher_snapshot on every /metrics render
+        and the CLI builds a Dispatcher before any prove: the module
+        must never pull in jax at import time."""
+        probe = (
+            "import builtins\n"
+            "real = builtins.__import__\n"
+            "def guard(name, *a, **k):\n"
+            "    assert not name.split('.')[0] == 'jax', name\n"
+            "    return real(name, *a, **k)\n"
+            "builtins.__import__ = guard\n"
+            "import spectre_tpu.prover_service.dispatcher\n"
+            "import spectre_tpu.utils.breaker\n"
+            "print('ok')\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", probe], capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == "ok"
+
+    def test_analysis_baseline_still_empty(self):
+        """ISSUE-11 satellite: the farm lands WITHOUT baselining any new
+        analysis finding — the shipped suppression list stays empty."""
+        import spectre_tpu.analysis as A
+        path = os.path.join(os.path.dirname(A.__file__), "baseline.json")
+        with open(path) as fh:
+            assert json.load(fh) == {"suppressions": []}
+
+    def test_fault_sites_documented(self):
+        for site in ("replica.dispatch", "replica.health", "replica.lease"):
+            assert site in faults.SITES
