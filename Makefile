@@ -1,6 +1,6 @@
 # Build orchestration (reference parity: `justfile` recipes).
 
-.PHONY: all native test test-slow test-faults test-farm fixtures bench bench-fast setup-committee setup-step lint lint-fast tpu-evidence report-ci
+.PHONY: all native test test-slow test-faults test-farm fixtures bench bench-fast bench-multichip setup-committee setup-step lint lint-fast tpu-evidence report-ci
 
 all: native
 
@@ -62,6 +62,15 @@ bench: native
 # throughput regression so `make test` surfaces perf rot without the 2^16 run
 bench-fast: native
 	python bench.py --fast
+
+# multi-chip gate (PR 13): 8 simulated devices (XLA host-platform flag),
+# sharded MSM + NTT micro-floors AND a complete byte-checked k=13 mesh
+# prove, all under one hard wall-clock budget (BENCH_MULTICHIP_TIMEOUT,
+# default 2700s) — the regression gate for the historical rc=124 where
+# per-call shard_map re-jitting made the 8-device prove never finish.
+# Knobs: SPECTRE_BENCH_DEVICES (8), SPECTRE_MESH_SHAPE, BENCH_MULTICHIP_K.
+bench-multichip: native
+	BENCH_METRIC=multichip python bench.py --fast
 
 # manifest CI gate (PR 10): diff a candidate provenance manifest against
 # a baseline and exit 3 on a prove_s regression (> 10% by default) or any

@@ -621,6 +621,88 @@ def _build_endo():
     return (lambda p: E.endo(p)), (pts,)
 
 
+# --- mesh-sharded kernels (ISSUE 13) ---------------------------------------
+# The SPMD programs are shard_map closures (opaque to this tracer), so the
+# per-shard LOCAL bodies are extracted as module functions in
+# parallel/sharded_msm.py / sharded_ntt.py and traced here exactly as a
+# single shard sees them: widx stands in for lax.axis_index, collectives
+# (all_gather/all_to_all) happen outside these roots and move data only.
+
+def _build_sharded_fold():
+    import jax.numpy as jnp
+    from ..parallel.sharded_msm import _fold_points
+    stacked = jnp.asarray(_u32((4, 2, 3, 16)))
+    return (lambda s: _fold_points(s)), (stacked,)
+
+
+def _build_sharded_windows_signed():
+    import jax.numpy as jnp
+    from ..parallel.sharded_msm import _shard_windows_signed
+    pts = jnp.asarray(_u32((8, 3, 16)))
+    sc = jnp.asarray(_u32((8, 8)))      # GLV half-scalar magnitudes
+    neg = jnp.zeros(8, dtype=bool)
+    widx = jnp.uint32(0)
+    # c=4 / 32 windows, one window shard (nloc == nwin_padded == nwin)
+    return (lambda p, s, g, w: _shard_windows_signed(
+        p, s, g, w, 4, 32, 32, 32, (1 << 3) + 1)), (pts, sc, neg, widx)
+
+
+def _build_sharded_windows_unsigned():
+    import jax.numpy as jnp
+    from ..parallel.sharded_msm import _shard_windows_unsigned
+    pts = jnp.asarray(_u32((8, 3, 16)))
+    sc = jnp.asarray(_u32((8, 16)))     # full 254-bit scalars
+    widx = jnp.uint32(0)
+    return (lambda p, s, w: _shard_windows_unsigned(
+        p, s, w, 4, 8, 8, 8, 1 << 4)), (pts, sc, widx)
+
+
+def _build_sharded_fixed():
+    import jax.numpy as jnp
+    from ..parallel.sharded_msm import _shard_fixed_local
+    c, nwin, n2 = 8, 16, 4
+    table = jnp.asarray(_u32((nwin, n2, 3, 16)))
+    sc = jnp.asarray(_u32((n2, 8)))
+    neg = jnp.zeros(n2, dtype=bool)
+    widx = jnp.uint32(0)
+    return (lambda t, s, g, w: _shard_fixed_local(
+        t, s, g, w, c, nwin, nwin, nwin, (1 << (c - 1)) + 1)), \
+        (table, sc, neg, widx)
+
+
+def _build_sharded_table():
+    import jax.numpy as jnp
+    from ..parallel.sharded_msm import _build_table_local
+    pts = jnp.asarray(_u32((4, 3, 16)))
+    # tiny chains (c=2, 4 windows, padded to 8) — bounds don't depend on
+    # the doubling-chain length
+    return (lambda p: _build_table_local(p, 2, 4, 8)), (pts,)
+
+
+def _build_sharded_ntt_rows():
+    def build():
+        import jax.numpy as jnp
+        from ..parallel.sharded_ntt import _rows_local
+        from ..plonk.domain import Domain
+        omega_row = Domain(3).omega
+        block = jnp.asarray(_u32((4, 8, 16)))
+        twb = jnp.asarray(_u32((4, 8, 16)))
+        return (lambda b, t: _rows_local(b, t, omega_row, "radix2")), \
+            (block, twb)
+    return build
+
+
+def _build_sharded_ntt_cols():
+    def build():
+        import jax.numpy as jnp
+        from ..parallel.sharded_ntt import _cols_local
+        from ..plonk.domain import Domain
+        omega_col = Domain(3).omega
+        y = jnp.asarray(_u32((4, 8, 16)))
+        return (lambda b: _cols_local(b, omega_col, "radix2")), (y,)
+    return build
+
+
 def _build_field_mxu():
     def build():
         from ..ops import field_mxu as M
@@ -706,6 +788,29 @@ KERNELS = [
     KernelSpec("msm.msm_windows_bits", "spectre_tpu/ops/msm.py",
                _build_msm_bits),
     KernelSpec("ec.endo", "spectre_tpu/ops/ec.py", _build_endo),
+    # mesh-sharded MSM/NTT per-shard bodies (ISSUE 13): the shard_map
+    # programs route ALL local math through these extracted roots, so a
+    # width/float regression in the distributed path shows up here without
+    # needing a device mesh in the linter
+    KernelSpec("sharded_msm.fold_points",
+               "spectre_tpu/parallel/sharded_msm.py", _build_sharded_fold),
+    KernelSpec("sharded_msm.windows_shard_signed",
+               "spectre_tpu/parallel/sharded_msm.py",
+               _build_sharded_windows_signed, in_bits=[16, 16, 1, 1]),
+    KernelSpec("sharded_msm.windows_shard",
+               "spectre_tpu/parallel/sharded_msm.py",
+               _build_sharded_windows_unsigned, in_bits=[16, 16, 1]),
+    KernelSpec("sharded_msm.fixed_shard",
+               "spectre_tpu/parallel/sharded_msm.py",
+               _build_sharded_fixed, in_bits=[16, 16, 1, 1]),
+    KernelSpec("sharded_msm.table_build_shard",
+               "spectre_tpu/parallel/sharded_msm.py", _build_sharded_table),
+    KernelSpec("sharded_ntt.rows_shard",
+               "spectre_tpu/parallel/sharded_ntt.py",
+               _build_sharded_ntt_rows()),
+    KernelSpec("sharded_ntt.cols_shard",
+               "spectre_tpu/parallel/sharded_ntt.py",
+               _build_sharded_ntt_cols()),
     # MXU int8-limb matmul field multiply (shapes stabilized; the
     # dot_general rule reads its preferred_element_type accumulator)
     KernelSpec("field_mxu.mont_mul", "spectre_tpu/ops/field_mxu.py",

@@ -8,5 +8,6 @@ the TPU-native equivalents:
   (c) pipeline: witness gen (host) overlapped with device commit phases
 """
 
-from .mesh import make_mesh, default_mesh  # noqa: F401
+from .mesh import make_mesh, default_mesh, MeshShapeError  # noqa: F401
+from .plan import ShardingPlan, plan_for_mesh, current_plan  # noqa: F401
 from .sharded_msm import sharded_msm  # noqa: F401

@@ -21,7 +21,13 @@ from ..ops import msm as MSM
 
 
 def _batch_mesh(ndev: int | None = None) -> Mesh:
-    devs = jax.devices()[: ndev or jax.local_device_count()]
+    if ndev is None:
+        # the interned plan's 1-D batch mesh: same device subset as the
+        # ("data","win") mesh (honors SPECTRE_MESH_SHAPE), stable object so
+        # the runner caches below never churn
+        from .plan import current_plan
+        return current_plan().batch_mesh
+    devs = jax.devices()[:ndev]
     return Mesh(devs, ("batch",))
 
 

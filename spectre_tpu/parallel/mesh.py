@@ -6,34 +6,81 @@ Axes:
 
 On a v4-8 (8 chips) the default is a 4x2 (data, win) mesh; single-chip and
 virtual-CPU configurations collapse gracefully.
+
+`SPECTRE_MESH_SHAPE` overrides the default: "4x2" -> data=4, win=2;
+a bare "8" means data=8, win=1. Shapes over a SUBSET of the local devices
+are allowed (e.g. "2x1" on an 8-device host picks the first 2) — that is
+how the mesh-vs-single-device identity tests run 1/2/8-device proves in
+one process. A shape that needs more devices than exist, or that isn't a
+positive DxW grid, raises `MeshShapeError` instead of silently collapsing
+to one device (the round-1 failure mode: a 1x1 mesh "validating" nothing).
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
 
+class MeshShapeError(ValueError):
+    """Requested mesh shape cannot be built from the available devices."""
+
+
+def _parse_shape(spec: str) -> tuple[int, int]:
+    parts = spec.lower().replace("×", "x").split("x")
+    try:
+        dims = [int(p) for p in parts if p != ""]
+    except ValueError:
+        dims = []
+    if len(dims) == 1:
+        dims.append(1)
+    if len(dims) != 2 or dims[0] < 1 or dims[1] < 1:
+        raise MeshShapeError(
+            f"SPECTRE_MESH_SHAPE={spec!r}: expected 'DATAxWIN' with positive "
+            f"integers (e.g. '4x2', '8', '2x1')")
+    return dims[0], dims[1]
+
+
 def make_mesh(n_devices: int | None = None, data_axis: int | None = None,
               devices: list | None = None, strict: bool = False) -> Mesh:
     devs = devices if devices is not None else jax.devices()
     if n_devices is not None:
-        if strict and len(devs) < n_devices:
-            raise RuntimeError(
-                f"make_mesh: {n_devices} devices requested but only "
-                f"{len(devs)} available — refusing to validate a collapsed "
-                f"mesh (round-1 failure mode: silently truncating to 1x1)")
+        if len(devs) < n_devices:
+            msg = (f"make_mesh: {n_devices} devices requested but only "
+                   f"{len(devs)} available — refusing to validate a collapsed "
+                   f"mesh (round-1 failure mode: silently truncating to 1x1)")
+            if strict:
+                raise RuntimeError(msg)
+            raise MeshShapeError(msg)
         devs = devs[:n_devices]
     n = len(devs)
     if data_axis is None:
         # prefer a 2D split when we have >= 4 devices
         data_axis = n // 2 if n >= 4 else n
+    if data_axis < 1 or n % data_axis != 0:
+        raise MeshShapeError(
+            f"make_mesh: data axis {data_axis} does not divide {n} devices")
     win_axis = n // data_axis
-    assert data_axis * win_axis == n, (data_axis, n)
     arr = np.array(devs).reshape(data_axis, win_axis)
     return Mesh(arr, axis_names=("data", "win"))
 
 
 def default_mesh() -> Mesh:
-    return make_mesh()
+    """All-local-devices ("data", "win") mesh, honoring SPECTRE_MESH_SHAPE.
+
+    With the knob set, the requested DxW grid is carved from the first D*W
+    local devices; needing more than exist is a MeshShapeError, never a
+    silent 1-device mesh."""
+    spec = os.environ.get("SPECTRE_MESH_SHAPE", "").strip()
+    if not spec:
+        return make_mesh()
+    d, w = _parse_shape(spec)
+    avail = len(jax.devices())
+    if d * w > avail:
+        raise MeshShapeError(
+            f"SPECTRE_MESH_SHAPE={spec!r} needs {d * w} devices but only "
+            f"{avail} are available")
+    return make_mesh(n_devices=d * w, data_axis=d)

@@ -194,6 +194,27 @@ class TestCompilelog:
             compilelog._listener("/jax/core/something_else", 1.0)
         assert cev == []
 
+    def test_persistent_cache_events_counted(self):
+        """The plain-event listener (ISSUE 13): persistent compile-cache
+        hit/miss events land in the capture sink and in summarize()'s
+        persistent_cache key — the bench-multichip 'warm disk cache vs
+        genuinely recompiled' signal."""
+        before = compilelog.cache_counts()
+        with compilelog.capture() as cev:
+            compilelog._event_listener("/jax/compilation_cache/cache_hits")
+            compilelog._event_listener("/jax/compilation_cache/cache_hits")
+            compilelog._event_listener("/jax/compilation_cache/cache_misses")
+            compilelog._event_listener("/jax/unrelated/event")
+        assert [e["event"] for e in cev] == [
+            "persistent_cache_hit", "persistent_cache_hit",
+            "persistent_cache_miss"]
+        s = compilelog.summarize(cev)
+        assert s["persistent_cache"] == {"hit": 2, "miss": 1}
+        assert s["count"] == 0            # cache events are not compiles
+        after = compilelog.cache_counts()
+        assert after["hit"] == before["hit"] + 2
+        assert after["miss"] == before["miss"] + 1
+
     def test_unattributed_outside_any_span(self):
         with compilelog.capture() as cev:
             compilelog._listener(

@@ -1,0 +1,153 @@
+"""Fast mesh/ShardingPlan tests (ISSUE 13) — no SPMD compiles.
+
+Everything here exercises plan construction, env-knob parsing, cache
+identity, and budget arithmetic on the virtual 8-device mesh that
+conftest.py forces; nothing traces an 8-way program, so the whole module
+stays tier-1-eligible. The minutes-scale SPMD byte-equality matrix lives
+in tests/test_parallel.py behind RUN_SLOW.
+"""
+
+import pytest
+
+import jax
+
+from spectre_tpu.parallel import (MeshShapeError, current_plan, default_mesh,
+                                  make_mesh, plan_for_mesh)
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs 8 virtual devices")
+
+
+class TestMeshShapeKnob:
+    def test_default_is_full_mesh(self, monkeypatch):
+        monkeypatch.delenv("SPECTRE_MESH_SHAPE", raising=False)
+        mesh = default_mesh()
+        assert dict(mesh.shape) == {"data": 4, "win": 2}
+
+    def test_explicit_shape(self, monkeypatch):
+        monkeypatch.setenv("SPECTRE_MESH_SHAPE", "2x1")
+        assert dict(default_mesh().shape) == {"data": 2, "win": 1}
+
+    def test_bare_int_means_data_axis(self, monkeypatch):
+        monkeypatch.setenv("SPECTRE_MESH_SHAPE", "8")
+        assert dict(default_mesh().shape) == {"data": 8, "win": 1}
+
+    def test_single_device_shape(self, monkeypatch):
+        monkeypatch.setenv("SPECTRE_MESH_SHAPE", "1x1")
+        mesh = default_mesh()
+        assert plan_for_mesh(mesh).n_devices == 1
+
+    def test_too_many_devices_is_typed_error(self, monkeypatch):
+        monkeypatch.setenv("SPECTRE_MESH_SHAPE", "5x3")
+        with pytest.raises(MeshShapeError, match="15 devices"):
+            default_mesh()
+
+    def test_parse_garbage_is_typed_error(self, monkeypatch):
+        monkeypatch.setenv("SPECTRE_MESH_SHAPE", "bogus")
+        with pytest.raises(MeshShapeError):
+            default_mesh()
+
+    def test_mesh_shape_error_is_value_error(self):
+        # callers that catch ValueError (CLI arg validation) keep working
+        assert issubclass(MeshShapeError, ValueError)
+
+
+class TestPlanInterning:
+    def test_same_mesh_same_plan(self):
+        mesh = make_mesh(8)
+        assert plan_for_mesh(mesh) is plan_for_mesh(mesh)
+
+    def test_current_plan_tracks_env(self, monkeypatch):
+        monkeypatch.setenv("SPECTRE_MESH_SHAPE", "2x1")
+        p2 = current_plan()
+        assert (p2.ndata, p2.nwin_shards) == (2, 1)
+        monkeypatch.setenv("SPECTRE_MESH_SHAPE", "4x2")
+        p8 = current_plan()
+        assert (p8.ndata, p8.nwin_shards) == (4, 2)
+        assert p2.key != p8.key
+
+    def test_pad_rows_and_windows(self):
+        plan = plan_for_mesh(make_mesh(8))     # data=4, win=2
+        assert plan.pad_rows(37) == 40         # next multiple of 4
+        assert plan.pad_rows(40) == 40
+        assert plan.pad_windows(33) == 34      # next multiple of 2
+
+    def test_describe_shape(self):
+        plan = plan_for_mesh(make_mesh(8))
+        d = plan.describe()
+        assert d["n_devices"] == 8
+        assert d["mesh"] == {"data": 4, "win": 2}
+
+    def test_batch_mesh_is_cached_and_flat(self):
+        plan = plan_for_mesh(make_mesh(8))
+        bm = plan.batch_mesh
+        assert bm is plan.batch_mesh
+        assert dict(bm.shape) == {"batch": 8}
+
+
+class TestRunnerCaches:
+    """Stable jitted-program identity is THE rc=124 fix: a fresh jit per
+    call re-traces the 8-way SPMD program every MSM/NTT of a prove. Runner
+    construction is lazy (no trace until first call), so these stay fast."""
+
+    def test_msm_windows_runner_is_stable(self):
+        from spectre_tpu.parallel import sharded_msm as _  # noqa: F401
+        import importlib
+        SM = importlib.import_module("spectre_tpu.parallel.sharded_msm")
+        plan = plan_for_mesh(make_mesh(8))
+        a = SM._windows_runner(plan, 7, 254, False)
+        assert SM._windows_runner(plan, 7, 254, False) is a
+        assert SM._windows_runner(plan, 7, 254, True) is not a
+
+    def test_ntt_runner_is_stable(self, monkeypatch):
+        from spectre_tpu.parallel import sharded_ntt as SN
+        from spectre_tpu.plonk.domain import Domain
+        monkeypatch.setenv("SPECTRE_NTT_MODE", "radix2")
+        plan = plan_for_mesh(make_mesh(8))
+        omega = Domain(10).omega
+        a = SN._ntt_runner(plan, "data", 10, omega)
+        assert SN._ntt_runner(plan, "data", 10, omega) is a
+
+    def test_ntt_runner_keys_on_resolved_mode(self, monkeypatch):
+        # the env knob must not go stale inside a resident program
+        from spectre_tpu.parallel import sharded_ntt as SN
+        from spectre_tpu.plonk.domain import Domain
+        plan = plan_for_mesh(make_mesh(8))
+        omega = Domain(16).omega          # local dims 2^8
+        monkeypatch.setenv("SPECTRE_NTT_MODE", "radix2")
+        a = SN._ntt_runner(plan, "data", 16, omega)
+        monkeypatch.setenv("SPECTRE_NTT_MODE", "fourstep")
+        b = SN._ntt_runner(plan, "data", 16, omega)
+        assert a is not b
+
+
+class TestFixedMeshBudget:
+    """Per-DEVICE budget arithmetic for mesh-sharded fixed-base tables —
+    pure math, no tracing."""
+
+    def test_mesh_affords_ndata_times_larger_tables(self, monkeypatch):
+        import importlib
+        SM = importlib.import_module("spectre_tpu.parallel.sharded_msm")
+        from spectre_tpu.ops import msm as MSM
+        plan = plan_for_mesh(make_mesh(8))     # ndata=4
+        c, nbits = 8, 127
+        total = SM._sharded_table_bytes(1 << 12, c, nbits, plan)
+        # budget just under the WHOLE table but above the per-shard slice:
+        # a single device would degrade, the mesh must not
+        monkeypatch.setattr(MSM._TABLES, "budget", total // 2)
+        assert SM.fixed_fits_mesh(1 << 12, c, nbits, plan)
+        assert not SM._degrade_fixed_mesh(1 << 12, c, nbits, plan)
+
+    def test_degrade_records_health_counter(self, monkeypatch):
+        import importlib
+        SM = importlib.import_module("spectre_tpu.parallel.sharded_msm")
+        from spectre_tpu.ops import msm as MSM
+        from spectre_tpu.utils.health import HEALTH
+        plan = plan_for_mesh(make_mesh(8))
+        c, nbits = 8, 127
+        total = SM._sharded_table_bytes(1 << 12, c, nbits, plan)
+        monkeypatch.setattr(MSM._TABLES, "budget",
+                            total // plan.ndata - 1)   # busts per-shard
+        before = HEALTH.get("msm_fixed_degraded")
+        assert SM._degrade_fixed_mesh(1 << 12, c, nbits, plan)
+        assert HEALTH.get("msm_fixed_degraded") == before + 1

@@ -121,11 +121,14 @@ class TestShardedMsmRouting:
         """TpuBackend.msm: >= 2^min_logn points + >1 device -> sharded_msm
         (tiny threshold here; the production default is 2^20). Every MSM
         mode must survive the mesh: the GLV scalar-prep stage runs before
-        device_put, signed digits recode per shard, and `fixed` degrades to
-        glv+signed (tables don't shard — documented in backend)."""
+        device_put, signed digits recode per shard, and `fixed` runs
+        SHARDED since ISSUE 13 — the window table is built by the mesh
+        with rows co-resident with their point shards, and must NOT
+        degrade to glv+signed (pinned via the health counter)."""
         import numpy as np
         from spectre_tpu.plonk import backend as B
         from spectre_tpu.native import host
+        from spectre_tpu.utils.health import HEALTH
 
         monkeypatch.setenv("SPECTRE_SHARD_MSM_MIN_LOGN", "5")
         monkeypatch.setenv("SPECTRE_MSM_MODE", mode)
@@ -138,9 +141,13 @@ class TestShardedMsmRouting:
         for i, s in enumerate(scs):
             for j in range(4):
                 sc64[i, j] = (s >> (64 * j)) & 0xFFFFFFFFFFFFFFFF
+        degraded_before = HEALTH.get("msm_fixed_degraded")
         got = bk.msm(pts64, sc64)
         want = bn.g1_curve.msm(pts, scs)
         assert got == (int(want[0]), int(want[1]))
+        if mode == "fixed":
+            # the whole point of the sharded table: fixed stays fixed
+            assert HEALTH.get("msm_fixed_degraded") == degraded_before
 
 
 class TestBatchMsmGLVModes:
@@ -180,18 +187,40 @@ class TestMeshProve:
     (SURVEY §2c(a)). Same k as dryrun_multichip phase 4 (shared compile
     cache)."""
 
+    _fixture = None
+    _host_proofs: dict = {}
+
+    @classmethod
+    def _get_fixture(cls):
+        if cls._fixture is None:
+            from spectre_tpu.test_utils import mesh_prove_fixture
+            cls._fixture = mesh_prove_fixture(k=13)
+        return cls._fixture
+
+    @classmethod
+    def _host_proof(cls, ntt_mode):
+        # one CPU reference prove per NTT mode (the identity matrix below
+        # re-proves on every mesh shape against the SAME reference bytes)
+        if ntt_mode not in cls._host_proofs:
+            from spectre_tpu.plonk import backend as B
+            from spectre_tpu.plonk.prover import prove
+            from spectre_tpu.test_utils import seeded_blinding_rng
+            srs, pk, asg = cls._get_fixture()
+            cls._host_proofs[ntt_mode] = prove(
+                pk, srs, asg, B.CpuBackend(),
+                blinding_rng=seeded_blinding_rng())
+        return cls._host_proofs[ntt_mode]
+
     def test_full_prove_byte_equality_on_mesh(self, monkeypatch):
         from spectre_tpu.plonk import backend as B
         from spectre_tpu.plonk.prover import prove
         from spectre_tpu.plonk.verifier import verify
-        from spectre_tpu.test_utils import (mesh_prove_fixture,
-                                            seeded_blinding_rng)
+        from spectre_tpu.test_utils import seeded_blinding_rng
 
         monkeypatch.setenv("SPECTRE_SHARD_MSM_MIN_LOGN", "10")
         monkeypatch.setenv("SPECTRE_SHARD_NTT_MIN_LOGN", "10")
-        srs, pk, asg = mesh_prove_fixture(k=13)
-        p_host = prove(pk, srs, asg, B.CpuBackend(),
-                       blinding_rng=seeded_blinding_rng())
+        srs, pk, asg = self._get_fixture()
+        p_host = self._host_proof("default")
         tbk = B.TpuBackend()
         assert tbk._use_mesh(1 << 13, tbk._shard_ntt_min_logn)
         p_mesh = prove(pk, srs, asg, tbk,
@@ -199,3 +228,35 @@ class TestMeshProve:
         assert p_mesh == p_host
         inst = [asg.instances[0]] if asg.instances else [[]]
         assert verify(pk.vk, srs, inst, p_mesh)
+
+    @pytest.mark.parametrize("mesh_shape", ["1x1", "2x1", "4x2"])
+    @pytest.mark.parametrize("msm_mode", ["glv+signed", "fixed"])
+    @pytest.mark.parametrize("ntt_mode", ["radix2", "fourstep"])
+    def test_identity_matrix(self, monkeypatch, mesh_shape, msm_mode,
+                             ntt_mode):
+        """ISSUE 13 acceptance: proof bytes byte-identical across
+        1/2/8-device meshes for every MSM/NTT mode combo, with `fixed`
+        running SHARDED (the health counter pins no silent degrade).
+        1x1 means a one-device plan — the mesh gates disengage and the
+        plain single-device kernels prove, which IS the single-device arm
+        of the identity."""
+        from spectre_tpu.plonk import backend as B
+        from spectre_tpu.plonk.prover import prove
+        from spectre_tpu.test_utils import seeded_blinding_rng
+        from spectre_tpu.utils.health import HEALTH
+
+        monkeypatch.setenv("SPECTRE_SHARD_MSM_MIN_LOGN", "10")
+        monkeypatch.setenv("SPECTRE_SHARD_NTT_MIN_LOGN", "10")
+        monkeypatch.setenv("SPECTRE_MESH_SHAPE", mesh_shape)
+        monkeypatch.setenv("SPECTRE_MSM_MODE", msm_mode)
+        monkeypatch.setenv("SPECTRE_NTT_MODE", ntt_mode)
+        srs, pk, asg = self._get_fixture()
+        p_host = self._host_proof(ntt_mode)
+        degraded_before = HEALTH.get("msm_fixed_degraded")
+        p_mesh = prove(pk, srs, asg, B.TpuBackend(),
+                       blinding_rng=seeded_blinding_rng())
+        assert p_mesh == p_host, \
+            f"proof bytes diverge on {mesh_shape} / {msm_mode} / {ntt_mode}"
+        if msm_mode == "fixed":
+            assert HEALTH.get("msm_fixed_degraded") == degraded_before, \
+                "fixed mode silently degraded on the mesh"
