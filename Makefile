@@ -1,13 +1,13 @@
 # Build orchestration (reference parity: `justfile` recipes).
 
-.PHONY: all native test test-slow test-faults test-farm fixtures bench bench-fast bench-multichip setup-committee setup-step lint lint-fast tpu-evidence report-ci
+.PHONY: all native test test-slow test-faults test-farm test-gateway fixtures bench bench-fast bench-multichip bench-serve setup-committee setup-step lint lint-fast tpu-evidence report-ci
 
 all: native
 
 native:
 	$(MAKE) -C spectre_tpu/native
 
-test: native lint test-faults test-farm bench-fast
+test: native lint test-faults test-farm test-gateway bench-fast
 	python -m pytest tests/ -q
 
 # fault-injection tier (PR 3, grown in PR 6): deterministic resilience
@@ -27,9 +27,12 @@ test: native lint test-faults test-farm bench-fast
 # unbroken update chain across period boundaries, kill-mid-prove
 # byte-identical replay, cache-hit-never-touches-prover, beacon-outage
 # degrade/recover, corrupt-stored-update quarantine + re-prove.
+# PR 14 adds the gateway tier (test_gateway.py): pack corruption
+# quarantine -> rebuild, gateway.pack_write ioerror, torn pack-journal
+# tail, and the fault-scheduled 10^4-client acceptance drill.
 # Also part of the full pytest ladder above.
 test-faults: native
-	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py tests/test_service.py tests/test_observability.py tests/test_manifest.py tests/test_integrity.py tests/test_follower.py tests/test_farm.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py tests/test_service.py tests/test_observability.py tests/test_manifest.py tests/test_integrity.py tests/test_follower.py tests/test_farm.py tests/test_gateway.py -q
 
 # proof-farm failover matrix (PR 11, tests/test_farm.py): replica crash
 # mid-prove -> lease takeover with a byte-identical proof, breaker-open
@@ -39,6 +42,14 @@ test-faults: native
 # UpdateStore 10k-period RSS bound.
 test-farm: native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_farm.py -q
+
+# light-client serving gateway (PR 14, tests/test_gateway.py): HTTP
+# cache semantics (digest ETags stable across restarts, 304s, immutable
+# only below tip), pack byte-identity vs direct UpdateStore reads, pack
+# survival across restart + scrubber, and the follower -> loadgen
+# end-to-end drill with the fault schedule armed.
+test-gateway: native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_gateway.py -q
 
 test-slow: native
 	RUN_SLOW=1 python -m pytest tests/ -q
@@ -71,6 +82,13 @@ bench-fast: native
 # Knobs: SPECTRE_BENCH_DEVICES (8), SPECTRE_MESH_SHAPE, BENCH_MULTICHIP_K.
 bench-multichip: native
 	BENCH_METRIC=multichip python bench.py --fast
+
+# gateway read-plane tier (PR 14): 10^4-client in-process Zipf drill over
+# a synthetic sealed store — requests/s gated against bench_floor.json,
+# zero sealed-period store fallbacks asserted unconditionally. Knobs:
+# BENCH_SERVE_CLIENTS (10000), BENCH_SERVE_REQUESTS, BENCH_SERVE_PERIODS.
+bench-serve: native
+	JAX_PLATFORMS=cpu BENCH_METRIC=serve python bench.py --fast
 
 # manifest CI gate (PR 10): diff a candidate provenance manifest against
 # a baseline and exit 3 on a prove_s regression (> 10% by default) or any

@@ -638,6 +638,64 @@ def bench_multichip(fast: bool) -> bool:
                  "points/s")
 
 
+def bench_serve(fast: bool) -> bool:
+    """Gateway read-plane drill (BENCH_METRIC=serve / make bench-serve):
+    a scaled-down ISSUE-14 load drill — 10^4 simulated light clients,
+    Zipf over a synthetic sealed-period store, in process. The floor
+    gates requests/s; ZERO sealed-period store fallbacks is a hard
+    assertion at every tier (a fallback means the pack plane silently
+    stopped covering the sealed range — a correctness bug, not a perf
+    regression)."""
+    import tempfile
+
+    from spectre_tpu.follower.updates import UpdateStore
+    from spectre_tpu.gateway import Gateway
+    from spectre_tpu.loadgen import InProcessTarget, run_drill
+    from spectre_tpu.utils.health import ServiceHealth
+
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "10000"))
+    requests = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                  str(2 * clients)))
+    n_periods = int(os.environ.get("BENCH_SERVE_PERIODS", "32"))
+    health = ServiceHealth()
+    with tempfile.TemporaryDirectory() as tmp:
+        store = UpdateStore(tmp, health=health)
+        for p in range(1, n_periods + 1):
+            store.append_committee(p, {
+                "proof": "0x" + bytes([p % 251]).hex() * 64,
+                "committee_poseidon": hex(p * 7919 + 13),
+                "instances": [hex(p), hex(p + 1)]})
+        gw = Gateway(store, pack_periods=8, cache_mb=32, health=health)
+        tip = store.tip_period()
+        rep = run_drill(InProcessTarget(gw),
+                        periods=list(range(tip, 0, -1)), tip=tip,
+                        clients=clients, requests=requests, seed=14,
+                        health=health)
+    fallbacks = rep["gateway_counters"].get("gateway_store_fallbacks", 0)
+    record = {
+        "metric": f"gateway_serve {clients}-client drill",
+        "value": round(rep["rps"]),
+        "unit": "requests/s",
+        "requests": rep["requests"],
+        "clients": clients,
+        "periods": n_periods,
+        "latency_ms": rep["latency_ms"],
+        "ratio_304": rep["ratio_304"],
+        "sealed_requests": rep["sealed_requests"],
+        "sealed_store_fallbacks": fallbacks,
+        "pack_hits": rep["gateway_counters"].get("gateway_pack_hits", 0),
+    }
+    if fallbacks != 0:
+        record["failed"] = True
+        print(json.dumps(record))
+        print(f"FAIL: {fallbacks} sealed-period responses fell back to "
+              "the update store — every sealed period must be served "
+              "from the pack/304 plane", file=sys.stderr)
+        return False
+    return _emit(record, fast, "gateway_serve_requests_per_s",
+                 "requests/s")
+
+
 def main():
     if os.environ.get("BENCH_PHASE") == "device":
         kind = os.environ.get("BENCH_KIND")
@@ -666,6 +724,8 @@ def main():
         ok = bench_msm(fast) and ok
     if which in ("all", "ntt"):
         ok = bench_ntt(fast) and ok
+    if which in ("all", "serve"):
+        ok = bench_serve(fast) and ok
     # multichip is opt-in (BENCH_METRIC=multichip / make bench-multichip):
     # the k=13 mesh prove is minutes-scale even warm, too heavy for "all"
     if which == "multichip":

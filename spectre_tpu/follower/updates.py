@@ -199,6 +199,9 @@ class UpdateStore:
         # re-proved, not forgotten) so the tracker can re-derive holes
         # anywhere in [anchor, head], not just above the tip.
         self._anchor: int | None = None
+        # append observers (ISSUE 14: the gateway's pack-seal hook);
+        # called OUTSIDE the lock after each successful append
+        self._observers: list = []
         self._replay()
 
     # -- journal -----------------------------------------------------------
@@ -330,6 +333,7 @@ class UpdateStore:
             if self._anchor is None or period < self._anchor:
                 self._anchor = period
         self.health.incr("follower_updates_stored")
+        self._notify("committee", period)
         return rec
 
     def append_step(self, slot: int, result: dict,
@@ -345,6 +349,7 @@ class UpdateStore:
             offset = self._append(rec)
             self._steps.put(slot, rec, offset)
         self.health.incr("follower_steps_stored")
+        self._notify("step", slot)
         return rec
 
     # -- read (serving path: O(artifact read), no prover involved) ---------
@@ -401,6 +406,26 @@ class UpdateStore:
                 updates.append(rec)
         return updates, missing
 
+    # -- observers (ISSUE 14: gateway pack-seal hook) ----------------------
+
+    def add_append_observer(self, fn) -> None:
+        """Register ``fn(kind, key)`` to run after every successful
+        append (outside the store lock). Idempotent per callable."""
+        with self._lock:
+            if fn not in self._observers:
+                self._observers.append(fn)
+
+    def _notify(self, kind: str, key: int) -> None:
+        with self._lock:
+            observers = list(self._observers)
+        for fn in observers:
+            try:
+                fn(kind, key)
+            except Exception:
+                # an observer (pack build, metrics) must never break
+                # the proving append path
+                self.health.incr("follower_observer_failures")
+
     # -- chain queries -----------------------------------------------------
 
     def has_committee(self, period: int) -> bool:
@@ -414,6 +439,24 @@ class UpdateStore:
     def tip_period(self) -> int | None:
         with self._lock:
             return max(self._committee) if self._committee else None
+
+    def committee_digest(self, period: int) -> str | None:
+        """Metadata-only content digest for a stored committee period —
+        the gateway's ETag source. Never touches the artifact, so a
+        conditional-request (304) path costs one dict lookup."""
+        with self._lock:
+            rec = self._committee.get(int(period))
+            return None if rec is None else rec.get("digest")
+
+    def is_sealed(self, period: int) -> bool:
+        """A period is *sealed* once it is stored AND strictly below the
+        chain tip: its successor's prev_poseidon pins it, so the record
+        can never change — the gateway serves it as immutable."""
+        with self._lock:
+            period = int(period)
+            if period not in self._committee or not self._committee:
+                return False
+            return period < max(self._committee)
 
     def anchor_period(self) -> int | None:
         """The chain's trust anchor: the lowest committee period ever

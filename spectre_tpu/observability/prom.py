@@ -212,6 +212,32 @@ def render(health=None, jobs=None, registry=None) -> str:
                 _sample(out, mn, {"store": f.get("store", "")},
                         f.get(key, 0))
 
+    try:
+        from ..gateway.serving import gateway_snapshot
+        gateways = gateway_snapshot()
+    except Exception:
+        gateways = []
+    if gateways:
+        for key, help_ in (
+                ("packs", "Sealed update-range packs currently indexed"),
+                ("pack_periods", "Periods per full pack "
+                                 "(SPECTRE_PACK_PERIODS)"),
+                ("cache_bytes", "Gateway hot-cache occupancy (bytes)"),
+                ("cache_budget_bytes", "Gateway hot-cache byte budget "
+                                       "(SPECTRE_GATEWAY_CACHE_MB)"),
+                ("cache_entries", "Gateway hot-cache entry count"),
+                ("cache_hits", "Gateway hot-cache lookup hits"),
+                ("cache_misses", "Gateway hot-cache lookup misses")):
+            mn = f"spectre_gateway_{key}"
+            _family(out, mn, "gauge", help_)
+            for g in gateways:
+                cache = g.get("cache") or {}
+                if key.startswith("cache_"):
+                    v = cache.get(key[len("cache_"):], 0)
+                else:
+                    v = g.get(key) or 0
+                _sample(out, mn, {"store": g.get("store", "")}, v)
+
     lru = _lru_stats()
     if lru:
         counter_keys = ("hits", "builds", "evictions", "recomputes")

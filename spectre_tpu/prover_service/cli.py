@@ -129,6 +129,17 @@ def main(argv=None):
                    help="prove two-stage (aggregated) EVM proofs")
     f.add_argument("--job-timeout", type=float, default=None)
     f.add_argument("--queue-depth", type=int, default=None)
+    f.add_argument("--gateway", action="store_true",
+                   help="mount the cacheable GET /v1/* read plane "
+                        "(ISSUE 14): content-addressed ETags, 304s, "
+                        "immutable cache headers on sealed periods, "
+                        "pre-built update-range packs")
+    f.add_argument("--pack-periods", type=int, default=None,
+                   help="periods per sealed update pack (default "
+                        "$SPECTRE_PACK_PERIODS or 8)")
+    f.add_argument("--gateway-cache-mb", type=float, default=None,
+                   help="gateway hot-cache byte budget in MB (default "
+                        "$SPECTRE_GATEWAY_CACHE_MB or 64)")
 
     u = sub.add_parser("utils", help="deployment utilities")
     u.add_argument("util", choices=["committee-poseidon"])
@@ -267,9 +278,17 @@ def _follow_cmd(args, spec):
         beacon = BeaconClient(beacon_urls[0])
     fol = Follower(spec, beacon, jobs, directory=args.params_dir,
                    pubkeys=pubkeys, domain=domain, backfill=args.backfill)
+    gateway = None
+    if args.gateway:
+        from ..gateway import Gateway
+        gateway = Gateway(fol.store, pack_periods=args.pack_periods,
+                          cache_mb=args.gateway_cache_mb)
+        print(f"gateway mounted on /v1/* (pack_periods="
+              f"{gateway.packs.pack_periods}, cache "
+              f"{gateway.cache.budget >> 20} MB)", flush=True)
     serve(state, args.host, args.port, background=True,
           journal_dir=args.params_dir, job_timeout=args.job_timeout,
-          follower=fol, **queue_kw)
+          follower=fol, gateway=gateway, **queue_kw)
     print(f"following {args.beacon_api}; serving light-client updates "
           f"on {args.host}:{args.port}", flush=True)
     stop = threading.Event()
@@ -307,6 +326,15 @@ def _scrub_cmd(args):
             live.add((job.result_digest, ".bin"))
         if job.manifest_digest is not None:
             live.add((job.manifest_digest, MANIFEST_SUFFIX))
+    # a follower params dir keeps its verified updates (and the
+    # gateway's update-range packs) in the SAME artifact store — replay
+    # those journals too, or an offline pass expires the whole chain
+    from ..follower.updates import JOURNAL_NAME, UpdateStore
+    if os.path.exists(os.path.join(args.params_dir, JOURNAL_NAME)):
+        from ..gateway.packs import PackBuilder
+        ustore = UpdateStore(args.params_dir)
+        live |= ustore.live_artifacts()
+        live |= PackBuilder(ustore).live_artifacts()
     store = ArtifactStore(args.params_dir)
     summary = Scrubber(store, lambda: live,
                        min_age_s=args.min_age_s).scrub()

@@ -276,9 +276,11 @@ class Dispatcher:
             return
         open_leases: dict[str, str] = {}
         failed: list[tuple] = []
+        lines = 0
         for line in raw.split(b"\n"):
             if not line.strip():
                 continue
+            lines += 1
             try:
                 rec = json.loads(line)
             except ValueError:
@@ -299,6 +301,57 @@ class Dispatcher:
             self._excluded.setdefault(digest, set()).add(rid)
             self._takeover_due.add(digest)
             self.health.incr("dispatcher_leases_replayed")
+        # startup compaction (ISSUE 14 satellite, carried from PR 11):
+        # keep only what replay needs — the full grant/release history
+        # grows without bound on a long-lived farm head
+        kept = len(open_leases) + len(failed)
+        if lines > kept:
+            self._compact_journal(open_leases, failed)
+
+    def _compact_journal(self, open_leases: dict, failed: list):
+        """Atomically rewrite the lease journal down to its replay
+        fixpoint (the JobJournal.compact idiom): one `lease` record per
+        still-open lease and one failed `release` per exclusion —
+        replaying the compacted file reconstructs exactly the state
+        replaying the full history did. Crash-safe: the rewrite is
+        staged to a sidecar, fsync'd, then `os.replace`d; a crash in the
+        staged-but-unswapped window (fault site `replica.lease_compact`)
+        leaves the ORIGINAL journal untouched and the next startup
+        re-compacts. IO errors are tolerated (the journal keeps its full
+        history, counted on dispatcher_lease_compact_failures)."""
+        tmp = self._journal_path + ".compact"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                for digest, rid in sorted(failed):
+                    # a release with no prior grant replays straight
+                    # into the exclusion set
+                    f.write(json.dumps(
+                        {"event": "release", "digest": digest,
+                         "replica": rid, "outcome": "failed"},
+                        sort_keys=True) + "\n")
+                for digest, rid in sorted(open_leases.items()):
+                    f.write(json.dumps(
+                        {"event": "lease", "digest": digest,
+                         "replica": rid}, sort_keys=True) + "\n")
+                f.flush()
+                # crash window: sidecar staged, original journal intact
+                faults.check("replica.lease_compact")
+                os.fsync(f.fileno())
+            os.replace(tmp, self._journal_path)
+            try:
+                dfd = os.open(os.path.dirname(self._journal_path) or ".",
+                              os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                pass
+            self.health.incr("dispatcher_lease_compactions")
+        except faults.InjectedCrash:
+            raise
+        except Exception:
+            self.health.incr("dispatcher_lease_compact_failures")
 
     def _journal(self, rec: dict):
         """fsync'd append; `replica.lease` fires AFTER a grant lands on

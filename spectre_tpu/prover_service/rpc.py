@@ -163,6 +163,7 @@ class _Handler(BaseHTTPRequestHandler):
     follower = None            # optional: the light-client follower daemon
     dispatcher = None          # optional: proof-farm dispatcher (ISSUE 11)
     replica_id = None          # this server's id within a farm
+    gateway = None             # optional: cacheable read plane (ISSUE 14)
 
     def log_message(self, fmt, *args):  # quiet by default
         pass
@@ -187,6 +188,25 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):
+        if self.path.startswith("/v1/"):
+            # gateway read plane (ISSUE 14): content-addressed ETags,
+            # If-None-Match -> 304, immutable cache headers on sealed
+            # periods — designed so a stock CDN in front of this port
+            # absorbs the light-client fan-out
+            if self.gateway is None:
+                self.send_error(404, "gateway not mounted (serve with "
+                                     "--gateway)")
+                return
+            status, headers, body = self.gateway.handle_http(
+                self.path, self.headers)
+            self.send_response(status)
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+            return
         if self.path == "/metrics":
             # Prometheus scrape (ISSUE 7): text exposition 0.0.4 with
             # exact counter parity against /healthz (both read the same
@@ -410,7 +430,7 @@ class _Handler(BaseHTTPRequestHandler):
 def serve(state: ProverState, host: str = "127.0.0.1", port: int = 3000,
           background: bool = False, journal_dir: str | None = None,
           job_timeout: float | None = None, follower=None, dispatcher=None,
-          replica_id: str | None = None, **queue_kw):
+          replica_id: str | None = None, gateway=None, **queue_kw):
     """`journal_dir` defaults to the state's params_dir (when set) — pass
     explicitly to place the crash-safe job journal elsewhere; `job_timeout`
     is the default per-job deadline for async submissions. `follower`
@@ -419,9 +439,12 @@ def serve(state: ProverState, host: str = "127.0.0.1", port: int = 3000,
     replaces the local-state queue runner with a proof-farm Dispatcher —
     the queue, dedup and journal are unchanged; only WHERE proofs run
     moves. `replica_id` (default $SPECTRE_REPLICA_ID) names this server
-    in a farm: it is stamped into every RPC error's data. Extra
-    `queue_kw` (queue_depth, mem_watermark_mb, stall_timeout, ...) reach
-    the JobQueue's admission/supervision layer."""
+    in a farm: it is stamped into every RPC error's data. `gateway`
+    (ISSUE 14) mounts the cacheable GET /v1/* read plane: pass a
+    constructed Gateway, or True to build one over `follower`'s update
+    store. Extra `queue_kw` (queue_depth, mem_watermark_mb,
+    stall_timeout, ...) reach the JobQueue's admission/supervision
+    layer."""
     _Handler.state = state
     _Handler.jobs = ensure_jobs(state, journal_dir=journal_dir,
                                 default_timeout=job_timeout,
@@ -430,6 +453,17 @@ def serve(state: ProverState, host: str = "127.0.0.1", port: int = 3000,
     _Handler.dispatcher = dispatcher
     _Handler.replica_id = replica_id if replica_id is not None \
         else (os.environ.get("SPECTRE_REPLICA_ID") or None)
+    if gateway is True:
+        if follower is None:
+            raise ValueError("gateway=True requires a follower (the "
+                             "gateway serves its update store)")
+        from ..gateway import Gateway
+        gateway = Gateway(follower.store)
+    _Handler.gateway = gateway
+    if gateway is not None and _Handler.jobs is not None:
+        # packs must survive the scrubber's orphan expiry exactly like
+        # stored updates do
+        _Handler.jobs.add_live_provider(gateway.live_artifacts)
     server = ThreadingHTTPServer((host, port), _Handler)
     if background:
         t = threading.Thread(target=server.serve_forever, daemon=True)

@@ -31,9 +31,11 @@ import time
 import urllib.error
 import urllib.request
 
+from collections import OrderedDict
+
 from .rpc import (RPC_METHOD_COMMITTEE, RPC_METHOD_COMMITTEE_SUBMIT,
                   RPC_METHOD_STEP, RPC_METHOD_STEP_SUBMIT,
-                  SERVICE_OVERLOADED)
+                  SERVICE_OVERLOADED, UPDATE_UNAVAILABLE)
 
 
 class RpcError(RuntimeError):
@@ -83,6 +85,12 @@ class ProverClient:
         self._rng = rng
         self._clock = clock
         self._id = 0
+        # gateway-side conditional-request cache (ISSUE 14): path ->
+        # (etag, decoded body). Bounded LRU; 304 revalidations re-serve
+        # the cached decode without re-downloading the proof bytes.
+        self._etag_cache: "OrderedDict[str, tuple]" = OrderedDict()
+        self.etag_cache_max = 256
+        self.cache_304s = 0         # revalidated-not-modified responses
 
     @property
     def url(self) -> str:
@@ -321,6 +329,70 @@ class ProverClient:
         backlog, chain health (`chain_ok`), stored counts."""
         return self._call("followerStatus", {},
                           timeout=min(self.timeout, 30.0))
+
+    # -- gateway read plane (ISSUE 14) -------------------------------------
+
+    def _gateway_url(self, path: str, query: str = "") -> str:
+        from urllib.parse import urlsplit, urlunsplit
+        parts = urlsplit(self.url)
+        return urlunsplit((parts.scheme, parts.netloc, path, query, ""))
+
+    def _cached_get(self, path: str, query: str = "") -> dict:
+        """Conditional GET against the gateway's /v1/* routes: sends
+        If-None-Match from the client-side digest cache, honors 304 by
+        re-serving the cached decode. 404 surfaces as the same typed
+        -32007 `update unavailable` the RPC method raises."""
+        key = path + ("?" + query if query else "")
+        cached = self._etag_cache.get(key)
+        req = urllib.request.Request(self._gateway_url(path, query))
+        if cached is not None:
+            req.add_header("If-None-Match", cached[0])
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=min(self.timeout, 30.0)) as resp:
+                body = json.load(resp)
+                etag = resp.headers.get("ETag")
+        except urllib.error.HTTPError as exc:
+            if exc.code == 304 and cached is not None:
+                exc.read()
+                self.cache_304s += 1
+                self._etag_cache.move_to_end(key)
+                return cached[1]
+            if exc.code == 404:
+                try:
+                    message = json.load(exc).get("error", "not found")
+                except ValueError:
+                    message = "not found"
+                raise RpcError(UPDATE_UNAVAILABLE, message)
+            raise
+        if etag:
+            self._etag_cache[key] = (etag, body)
+            self._etag_cache.move_to_end(key)
+            while len(self._etag_cache) > self.etag_cache_max:
+                self._etag_cache.popitem(last=False)
+        return body
+
+    def get_update_cached(self, period: int) -> dict:
+        """One committee update via the cacheable gateway route
+        (GET /v1/update/<period>): ETag-revalidated from the client-side
+        digest cache, so a sealed update is downloaded at most once per
+        client. Requires the server to mount the gateway
+        (`follow --gateway`); raises RpcError -32007 when the update is
+        not (yet) proved."""
+        return self._cached_get(f"/v1/update/{int(period)}")
+
+    def get_update_range_cached(self, start_period: int,
+                                count: int = 1) -> dict:
+        """Range variant of :meth:`get_update_cached`
+        (GET /v1/updates?start=..&count=..): returns
+        {"updates": [...], "missing": [...]} like get_update_range."""
+        return self._cached_get(
+            "/v1/updates", f"start={int(start_period)}&count={int(count)}")
+
+    def get_bootstrap_cached(self) -> dict:
+        """Cold-start document (GET /v1/bootstrap): trust anchor update
+        + tip period, short-TTL cached."""
+        return self._cached_get("/v1/bootstrap")
 
     def metrics_text(self) -> str:
         """Raw GET /metrics body (Prometheus text exposition 0.0.4) from

@@ -102,6 +102,15 @@ class ArtifactStore:
     def exists(self, digest: str, suffix: str = ".bin") -> bool:
         return os.path.exists(self.path_for(digest, suffix))
 
+    def size(self, digest: str, suffix: str = ".bin") -> int | None:
+        """On-disk byte size of an artifact, or None when absent —
+        metadata-only (no read, no verification); cache-budget
+        accounting for the gateway's pack hot set."""
+        try:
+            return os.stat(self.path_for(digest, suffix)).st_size
+        except OSError:
+            return None
+
     def write(self, data: bytes, suffix: str = ".bin",
               fault_site: str = "artifact.write") -> str:
         """Atomically persist `data`; returns its sha256 hex digest."""

@@ -101,6 +101,16 @@ SITES = {
                       "(the post-append crash window restart replay "
                       "must cover; `ioerror` is tolerated — counted on "
                       "dispatcher_lease_journal_failures)"),
+    "replica.lease_compact": ("prover_service/dispatcher.py",
+                              "lease-journal compaction, staged-sidecar "
+                              "swap window (kind `crash` leaves the "
+                              "original journal intact; replay must "
+                              "still see every open lease)"),
+    "gateway.pack_write": ("gateway/packs.py",
+                           "update-range pack artifact write (tolerated: "
+                           "serving falls back to the update store, "
+                           "counted on gateway_pack_build_failures, "
+                           "rebuilt on the next seal event)"),
 }
 
 

@@ -479,6 +479,99 @@ class TestLeaseReplay:
         assert d2.dispatch(METHOD, {"w": 1}) == _result()
 
 
+# -- lease-journal startup compaction (ISSUE 14 satellite) ------------------
+
+
+_LEASE_HISTORY = [
+    {"event": "lease", "digest": "d1", "replica": "a"},
+    {"event": "release", "digest": "d1", "replica": "a",
+     "outcome": "done"},
+    {"event": "lease", "digest": "d2", "replica": "a"},
+    {"event": "release", "digest": "d2", "replica": "a",
+     "outcome": "failed"},
+    {"event": "lease", "digest": "d3", "replica": "b"},   # still open
+]
+
+
+def _write_lease_journal(ddir, records=_LEASE_HISTORY) -> str:
+    path = os.path.join(ddir, "dispatcher.leases.jsonl")
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    return path
+
+
+class TestLeaseCompaction:
+    def test_startup_compaction_is_a_replay_fixpoint(self, tmp_path):
+        """Restart compacts the grant/release history down to open
+        leases + exclusions; replaying the compacted file reconstructs
+        the SAME state, and a further restart has nothing left to drop."""
+        ddir = str(tmp_path)
+        path = _write_lease_journal(ddir)
+        c0 = HEALTH.get("dispatcher_lease_compactions")
+        d1 = Dispatcher([LocalReplica(r, runner=_mk_runner([]))
+                         for r in ("a", "b")], journal_dir=ddir,
+                        poll_s=0.005)
+        assert HEALTH.get("dispatcher_lease_compactions") == c0 + 1
+        assert d1._excluded == {"d2": {"a"}, "d3": {"b"}}
+        assert d1._takeover_due == {"d3"}
+        lines = [json.loads(ln) for ln in
+                 open(path).read().splitlines() if ln.strip()]
+        # the done pair and the open lease's separate grant are gone
+        assert len(lines) == 2
+        assert {(r["event"], r["digest"]) for r in lines} == \
+            {("release", "d2"), ("lease", "d3")}
+        # replaying the compacted journal reconstructs identical state
+        # and, being the fixpoint, does NOT compact again
+        d2 = Dispatcher([LocalReplica(r, runner=_mk_runner([]))
+                         for r in ("a", "b")], journal_dir=ddir,
+                        poll_s=0.005)
+        assert HEALTH.get("dispatcher_lease_compactions") == c0 + 1
+        assert d2._excluded == d1._excluded
+        assert d2._takeover_due == d1._takeover_due
+
+    def test_crash_mid_compact_leaves_original_journal(self, tmp_path,
+                                                       monkeypatch):
+        """`replica.lease_compact:crash` fires in the staged-but-not-
+        swapped window: the original journal survives byte-for-byte, and
+        the next startup re-compacts to the same state."""
+        ddir = str(tmp_path)
+        path = _write_lease_journal(ddir)
+        before = open(path, "rb").read()
+        monkeypatch.setenv("SPECTRE_FAULT_PLAN",
+                           "replica.lease_compact:crash:1")
+        with pytest.raises(faults.InjectedCrash):
+            Dispatcher([LocalReplica("a", runner=_mk_runner([]))],
+                       journal_dir=ddir, poll_s=0.005)
+        assert open(path, "rb").read() == before
+        monkeypatch.delenv("SPECTRE_FAULT_PLAN")
+        faults.clear()
+        d = Dispatcher([LocalReplica(r, runner=_mk_runner([]))
+                        for r in ("a", "b")], journal_dir=ddir,
+                       poll_s=0.005)
+        assert d._excluded == {"d2": {"a"}, "d3": {"b"}}
+        assert d._takeover_due == {"d3"}
+        lines = [ln for ln in open(path).read().splitlines() if ln.strip()]
+        assert len(lines) == 2
+
+    def test_compact_ioerror_tolerated_keeps_history(self, tmp_path,
+                                                     monkeypatch):
+        """Disk trouble during compaction degrades to keeping the full
+        history (counted), never to losing lease state."""
+        ddir = str(tmp_path)
+        path = _write_lease_journal(ddir)
+        before = open(path, "rb").read()
+        f0 = HEALTH.get("dispatcher_lease_compact_failures")
+        monkeypatch.setenv("SPECTRE_FAULT_PLAN",
+                           "replica.lease_compact:ioerror:1")
+        d = Dispatcher([LocalReplica(r, runner=_mk_runner([]))
+                        for r in ("a", "b")], journal_dir=ddir,
+                       poll_s=0.005)
+        assert HEALTH.get("dispatcher_lease_compact_failures") == f0 + 1
+        assert open(path, "rb").read() == before
+        assert d._excluded == {"d2": {"a"}, "d3": {"b"}}
+
+
 # -- multi-beacon quorum ----------------------------------------------------
 
 

@@ -535,6 +535,61 @@ class TestProverClient:
             client.ping()
         assert len(calls) == 2
 
+    def test_get_update_cached_honors_304(self, tmp_path):
+        """ISSUE-14 satellite: the client-side digest cache sends
+        If-None-Match and re-serves the cached decode on 304, so a
+        sealed update crosses the wire at most once per client."""
+        from spectre_tpu.follower.updates import UpdateStore
+        from spectre_tpu.gateway import Gateway
+        from spectre_tpu.prover_service.rpc import serve
+        from spectre_tpu.prover_service.rpc_client import (ProverClient,
+                                                           RpcError)
+        store = UpdateStore(str(tmp_path))
+        for p in range(3, 8):
+            store.append_committee(p, {"proof": "0x" + "ab" * 8,
+                                       "committee_poseidon": hex(p * 7 + 1),
+                                       "instances": [hex(p)]})
+        server = serve(_FakeState(TINY), port=0, background=True,
+                       gateway=Gateway(store, pack_periods=2))
+        port = server.server_address[1]
+        try:
+            client = ProverClient(f"http://127.0.0.1:{port}/rpc",
+                                  timeout=60)
+            first = client.get_update_cached(4)
+            assert first["period"] == 4
+            assert client.cache_304s == 0
+            assert client.get_update_cached(4) == first   # revalidated
+            assert client.cache_304s == 1
+            rng = client.get_update_range_cached(3, count=3)
+            assert [u["period"] for u in rng["updates"]] == [3, 4, 5]
+            assert client.get_update_range_cached(3, count=3) == rng
+            assert client.cache_304s == 2
+            boot = client.get_bootstrap_cached()
+            assert boot["anchor_period"] == 3 and boot["tip_period"] == 7
+            with pytest.raises(RpcError) as e:
+                client.get_update_cached(99)
+            assert e.value.code == -32007
+            # distinct keys stay independently cached; the 404 does not
+            assert len(client._etag_cache) == 3
+        finally:
+            server.shutdown()
+
+    def test_gateway_routes_404_without_mount(self):
+        """GET /v1/* on a server launched without --gateway is a plain
+        404, not a crash in the RPC handler."""
+        import urllib.error
+        import urllib.request
+        from spectre_tpu.prover_service.rpc import serve
+        server = serve(_FakeState(TINY), port=0, background=True)
+        port = server.server_address[1]
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/bootstrap", timeout=30)
+            assert e.value.code == 404
+        finally:
+            server.shutdown()
+
 
 class TestWaitForProofDeadline:
     """ISSUE 10 satellite: ONE overall deadline bounds wait_for_proof —
