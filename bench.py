@@ -41,6 +41,12 @@ any other value is pinned into the child's JAX_PLATFORMS.
 `python bench.py --fast` is the CI tier: 2^12 on pinned CPU, compared
 against the checked-in floor in bench_floor.json (fails on >20% regression).
 
+`python bench.py --sweep-window` times the MSM at each window width c and
+emits one points/s JSON line per width (see bench_sweep_window) — the
+measurement behind the default_window tables; SPECTRE_MSM_WINDOW pins a
+winner. The NTT child additionally reports `ntt_kernel` and a byte-checked
+stages-vs-matmul `kernel_compare` sample (SPECTRE_NTT_KERNEL).
+
 Multichip tier (ISSUE 13): BENCH_METRIC=multichip (= `make bench-multichip`)
 forces SPECTRE_BENCH_DEVICES virtual CPU devices in the child, runs the
 sharded MSM/NTT micro-kernels (oracle-checked) AND a complete k=13 mesh
@@ -339,12 +345,49 @@ def ntt_device_phase(out_path: str):
                 run_batched()
                 dt = min(dt, time.time() - t0)
 
+        # short-transform kernel comparison (SPECTRE_NTT_KERNEL): time the
+        # fourstep pipeline with butterfly stages vs the DFT-matmul body on
+        # a small sample of the same columns, byte-checked against each
+        # other — the honest stages-vs-matmul number for THIS platform
+        # (BASELINE.md: the matmul body targets the MXU; CPU runs it on
+        # im2col-style matmuls and is expected slower). BENCH_NTT_COMPARE=0
+        # skips the sample.
+        kcomp = None
+        if os.environ.get("BENCH_NTT_COMPARE", "1") != "0":
+            bc = min(batch, 4)
+            sample_d = stack_d[:bc]
+
+            def run_kernel(kern):
+                return np.asarray(NTT.coset_lde_std(
+                    sample_d, omega_ext, g, mode="fourstep", kernel=kern))
+
+            with phase("bench/kernel_compare"):
+                ks = {}
+                outs = {}
+                for kern in NTT.NTT_KERNELS:
+                    outs[kern] = run_kernel(kern)      # compile + warm
+                    kdt = float("inf")
+                    for _ in range(2):
+                        t0 = time.time()
+                        run_kernel(kern)
+                        kdt = min(kdt, time.time() - t0)
+                    ks[kern] = round(bc / kdt, 3)
+                if not np.array_equal(outs["stages"], outs["matmul"]):
+                    with open(out_path, "w") as f:
+                        json.dump({"error": "ntt kernel compare: matmul "
+                                   "result differs from stages",
+                                   "backend": jax.default_backend()}, f)
+                    return
+                kcomp = {"mode": "fourstep", "batch": bc,
+                         "polys_per_s": ks}
+
         comp = compilelog.summarize(cev)
         with open(out_path, "w") as f:
             json.dump({"polys_per_s": batch / dt,
                        "baseline_polys_per_s": batch / base_dt,
                        "jitted_loop_polys_per_s": batch / jl_dt,
-                       "ntt_mode": mode, "impl": "batched",
+                       "ntt_mode": mode, "ntt_kernel": NTT.ntt_kernel(),
+                       "kernel_compare": kcomp, "impl": "batched",
                        "phase_seconds": tracing.phase_seconds(tr),
                        "compile_seconds": comp["seconds"],
                        "compile_count": comp["count"],
@@ -718,6 +761,9 @@ def main():
         os.environ.setdefault("BENCH_LOGN", "12")
         os.environ.setdefault("SPECTRE_BENCH_PLATFORM", "cpu")
 
+    if "--sweep-window" in sys.argv[1:]:
+        sys.exit(0 if bench_sweep_window() else 1)
+
     which = os.environ.get("BENCH_METRIC", "all")
     ok = True
     if which in ("all", "msm"):
@@ -732,6 +778,75 @@ def main():
         ok = bench_multichip(fast) and ok
     if not ok:
         sys.exit(1)
+
+
+def bench_sweep_window() -> bool:
+    """`python bench.py --sweep-window`: time the full MSM at each window
+    width c and print one JSON line per width (points/s) plus a summary
+    with the fastest c — the measurement that picks the default_window
+    tables; SPECTRE_MSM_WINDOW then pins the winner fleet-wide.
+
+    Runs in-process on the default JAX backend (SPECTRE_BENCH_PLATFORM
+    pins it). BENCH_LOGN sizes the instance (default 2^12 — minutes-scale
+    on CPU); BENCH_SWEEP_CS overrides the width list. Mode defaults to
+    `vanilla` (SPECTRE_MSM_MODE overrides): the fixed-base path rebuilds
+    its precomputed table per c, which would time table builds, not MSMs.
+    Every width's result is checked equal (affine) to the first width's —
+    a sweep that returns different points is a bug, not a datapoint."""
+    platform = os.environ.get("SPECTRE_BENCH_PLATFORM")
+    if platform:
+        os.environ.setdefault("JAX_PLATFORMS", platform)
+    import jax
+    import jax.numpy as jnp
+
+    from spectre_tpu.ops import ec, field_ops as F, limbs as L, msm as MSM
+
+    logn = int(os.environ.get("BENCH_LOGN", "12"))
+    n = 1 << logn
+    mode = os.environ.get("SPECTRE_MSM_MODE", "vanilla")
+    cs = [int(c) for c in os.environ.get(
+        "BENCH_SWEEP_CS", "4,6,8,10,12").split(",")]
+    pts64, sc64 = bench_inputs(logn)
+
+    ctxq = F.fq_ctx()
+    to_mont = jax.jit(lambda v: F.to_mont(ctxq, v))
+    xm = to_mont(jnp.asarray(L.u64limbs_to_u16limbs(pts64[:, :4])))
+    ym = to_mont(jnp.asarray(L.u64limbs_to_u16limbs(pts64[:, 4:])))
+    one = jnp.broadcast_to(jnp.asarray(ctxq.one_mont), (n, F.NLIMBS))
+    pts = jnp.stack([xm, ym, one], axis=1)
+    sc16 = jnp.asarray(L.u64limbs_to_u16limbs(sc64))
+
+    want_affine = None
+    results = {}
+    for c in cs:
+        def run():
+            return np.asarray(MSM.msm(pts, sc16, c=c, mode=mode,
+                                      base_key=("sweep", logn, c)))
+
+        res = run()                                # compile + warm
+        affine = ec.decode_points(jnp.asarray(res)[None])[0]
+        if want_affine is None:
+            want_affine = affine
+        elif affine != want_affine:
+            print(f"FAIL: window sweep c={c} result diverges",
+                  file=sys.stderr)
+            return False
+        dt = float("inf")
+        for _ in range(2):
+            t0 = time.time()
+            run()
+            dt = min(dt, time.time() - t0)
+        results[c] = round(n / dt)
+        print(json.dumps({"metric": f"bn254_msm_2^{logn} window sweep",
+                          "c": c, "value": results[c], "unit": "points/s",
+                          "msm_mode": mode,
+                          "backend": jax.default_backend()}))
+    best = max(results, key=results.get)
+    print(json.dumps({"metric": f"bn254_msm_2^{logn} window sweep best",
+                      "best_c": best, "value": results[best],
+                      "unit": "points/s", "msm_mode": mode,
+                      "backend": jax.default_backend()}))
+    return True
 
 
 def bench_msm(fast: bool) -> bool:
@@ -850,10 +965,14 @@ def bench_ntt(fast: bool) -> bool:
         "vs_baseline": round(value / baseline, 3),
         "backend": result.get("backend"),
         "ntt_mode": result.get("ntt_mode", bench_ntt_mode()),
+        "ntt_kernel": result.get("ntt_kernel"),
         "impl": result.get("impl"),
         "fallback": fallback,
         "self_verify": os.environ.get("SPECTRE_SELF_VERIFY", "always"),
     }
+    if result.get("kernel_compare"):
+        # stages-vs-matmul short-transform sample (byte-checked in-child)
+        record["kernel_compare"] = result["kernel_compare"]
     jl = result.get("jitted_loop_polys_per_s")
     if jl:
         # decomposition: how much of vs_baseline is batching+fusion vs

@@ -121,10 +121,12 @@ def _interp_jaxpr(jaxpr, consts, in_bounds, lint: _Lint, check: bool,
                   path: str = ""):
     """Abstract interpretation of one (open) jaxpr. Returns out bounds."""
     env: dict = {}
+    cvals: dict = {}   # id(constvar) -> numpy value, for const-aware rules
     for v, c in zip(jaxpr.constvars, consts):
         arr = np.asarray(c)
         if np.issubdtype(arr.dtype, np.integer) or arr.dtype == np.bool_:
             env[v] = int(arr.max()) if arr.size else 0
+            cvals[id(v)] = arr
         else:
             env[v] = 0  # float consts caught by the KL-FLOAT walk
     for v, b in zip(jaxpr.invars, in_bounds):
@@ -134,10 +136,19 @@ def _interp_jaxpr(jaxpr, consts, in_bounds, lint: _Lint, check: bool,
 
     for ei, eqn in enumerate(jaxpr.eqns):
         outs = _eval_eqn(eqn, ei, env, jaxpr.eqns, outvar_set, lint, check,
-                         path)
+                         path, cvals)
         for ov, ob in zip(eqn.outvars, outs):
             env[ov] = ob
     return [_atom_bound(v, env) for v in jaxpr.outvars]
+
+
+def _const_value(atom, cvals):
+    """Integer numpy value of an atom when statically known, else None."""
+    import jax.core as jcore
+    if isinstance(atom, jcore.Literal):
+        arr = np.asarray(atom.val)
+        return arr if np.issubdtype(arr.dtype, np.integer) else None
+    return cvals.get(id(atom)) if cvals else None
 
 
 def _flag(lint, check, eqn, ei, path, env, eqns, outvar_set, true_val,
@@ -157,7 +168,7 @@ def _flag(lint, check, eqn, ei, path, env, eqns, outvar_set, true_val,
 
 
 def _eval_eqn(eqn, ei, env, eqns, outvar_set, lint: _Lint, check: bool,
-              path: str):
+              path: str, cvals: dict | None = None):
     prim = eqn.primitive.name
     params = eqn.params
     ins = [_atom_bound(a, env) for a in eqn.invars]
@@ -288,6 +299,24 @@ def _eval_eqn(eqn, ei, env, eqns, outvar_set, lint: _Lint, check: bool,
         acc_name = np.dtype(acc_dt).name if acc_dt is not None \
             else np.dtype(eqn.outvars[0].aval.dtype).name
         true = ins[0] * ins[1] * k
+        # const-operand refinement: when one side is a statically known
+        # integer matrix (one-hot conv reductions, DFT twiddle tables), the
+        # true per-output-entry bound is other_bound * max column |sum| of
+        # the const over ITS contraction dims — for the one-hot [1024, 63]
+        # convolution matrix that is other_bound * L8 (32), not
+        # other_bound * 1024, which is what PROVES the C*L*255^2 int32
+        # column bound of the matmul-NTT short transform
+        try:
+            (lc, rc), _ = dims
+            for idx, cdims in ((0, tuple(lc)), (1, tuple(rc))):
+                arr = _const_value(eqn.invars[idx], cvals)
+                if arr is None or not cdims:
+                    continue
+                colsum = int(np.abs(arr.astype(np.int64)).sum(
+                    axis=cdims).max()) if arr.size else 0
+                true = min(true, ins[1 - idx] * colsum)
+        except Exception:
+            pass
         _flag(lint, check, eqn, ei, path, env, eqns, outvar_set, true,
               acc_max,
               f"dot_general accumulating {k} products in {acc_name} "
@@ -561,6 +590,57 @@ def _build_coset_intt_std():
     return build
 
 
+def _build_ntt_fourstep_matmul():
+    def build():
+        import jax.numpy as jnp
+        from ..ops import ntt as NTT
+        from ..plonk.domain import Domain
+        omega = Domain(4).omega                 # n=16 -> 4x4 matmul legs
+        a = jnp.asarray(_u32((2, 16, 16)))
+        return (lambda x: NTT._fwd_kernel.__wrapped__(
+            x, omega, None, "fourstep", "matmul")), (a,)
+    return build
+
+
+def _build_dft_matmul():
+    def build():
+        import jax.numpy as jnp
+        from ..ops import ntt as NTT
+        from ..plonk.domain import Domain
+        # n=64 is the smallest length where the naive dot_general estimate
+        # (n·255² · 1024 one-hot products) exceeds int32 — the const-colsum
+        # refinement must PROVE the true C·L·255² column bound here
+        omega = Domain(6).omega
+        a = jnp.asarray(_u32((64, 16)))
+        return (lambda x: NTT._ntt_dft_matmul(x, 6, omega)), (a,)
+    return build
+
+
+def _build_coset_intt_std_vinv():
+    def build():
+        import jax.numpy as jnp
+        from ..ops import ntt as NTT
+        from ..plonk.domain import Domain
+        dom = Domain(2)                         # n_ext = 16
+        a = jnp.asarray(_u32((2, 16, 16)))
+        # the folded quotient inverse: vanishing-inverse period tuple as
+        # the stage-0 pre-scale (real Domain values, as the prover passes)
+        return (lambda x: NTT._inv_kernel.__wrapped__(
+            x, dom.omega_ext, 7, True, "radix2", "stages",
+            dom.vanishing_inv_period_vals())), (a,)
+    return build
+
+
+def _build_pallas_padd():
+    def build():
+        import jax.numpy as jnp
+        from ..ops import msm_pallas as MP
+        p = jnp.asarray(_u32((48, 4)))
+        q = jnp.asarray(_u32((48, 4)))
+        return (lambda a, b: MP._k_padd(a, b)), (p, q)
+    return build
+
+
 def _build_msm():
     import jax.numpy as jnp
     from ..ops import msm as M
@@ -767,6 +847,20 @@ KERNELS = [
                _build_coset_lde("fourstep")),
     KernelSpec("ntt.coset_intt_std", "spectre_tpu/ops/ntt.py",
                _build_coset_intt_std()),
+    # MXU-native matmul NTT (this PR): the DFT-matmul short-transform body
+    # both inside the fourstep pipeline and standalone at the length where
+    # the int32 column bound needs the const-colsum dot_general refinement,
+    # plus the folded quotient vanishing-inverse variant of the fused iNTT
+    KernelSpec("ntt.fourstep_matmul", "spectre_tpu/ops/ntt.py",
+               _build_ntt_fourstep_matmul()),
+    KernelSpec("ntt.dft_matmul", "spectre_tpu/ops/ntt.py",
+               _build_dft_matmul()),
+    KernelSpec("ntt.coset_intt_std_vinv", "spectre_tpu/ops/ntt.py",
+               _build_coset_intt_std_vinv()),
+    # Pallas MSM complete-add body (this PR): the exact jaxpr pallas_call
+    # runs per block, traced directly so KL rules see the CIOS scans
+    KernelSpec("msm_pallas.padd_body", "spectre_tpu/ops/msm_pallas.py",
+               _build_pallas_padd()),
     KernelSpec("msm.msm_windows", "spectre_tpu/ops/msm.py", _build_msm),
     KernelSpec("msm.combine_windows", "spectre_tpu/ops/msm.py",
                _build_msm_combine),

@@ -51,18 +51,23 @@ MASK8 = np.uint32(0xFF)
 
 
 @functools.cache
-def _conv_matrix(full: bool) -> np.ndarray:
-    """One-hot [L8*L8, out] reduction matrix: (i,j) -> column i+j.
-    full=True keeps all 2L output columns; full=False truncates to the low
-    L columns (mod-2^256 products for the Montgomery m step)."""
-    out_cols = 2 * L8 if full else L8
-    S = np.zeros((L8 * L8, out_cols), dtype=np.int32)
-    for i in range(L8):
-        for j in range(L8):
+def conv_matrix(la: int, lb: int, out_cols: int) -> np.ndarray:
+    """One-hot [la*lb, out_cols] reduction matrix: (i,j) -> column i+j.
+    Truncating out_cols below la+lb-1 drops high columns (mod-2^(8*out)
+    products for the Montgomery m step). Shared with the matmul-NTT short
+    transform in ops/ntt.py, which convolves 33-limb reduction constants
+    against 32-limb data."""
+    S = np.zeros((la * lb, out_cols), dtype=np.int32)
+    for i in range(la):
+        for j in range(lb):
             k = i + j
             if k < out_cols:
-                S[i * L8 + j, k] = 1
+                S[i * lb + j, k] = 1
     return S
+
+
+def _conv_matrix(full: bool) -> np.ndarray:
+    return conv_matrix(L8, L8, 2 * L8 if full else L8)
 
 
 class MxuCtx:
@@ -119,16 +124,22 @@ def _carry8(t, out_limbs: int):
     return outs[..., :out_limbs]
 
 
-def _mul_columns(a8, b8, full: bool):
+def mul_columns(a8, b8, out_cols: int):
     """Raw column products via the one-hot matmul; no carries yet.
-    a8, b8: [..., 32] int32 (entries < 2^8). Returns [..., 2L or L] int32."""
-    outer = a8[..., :, None] * b8[..., None, :]           # [..., 32, 32] VPU
-    flat = outer.reshape(*outer.shape[:-2], L8 * L8)
-    S = _conv_matrix(full)
-    # [N, 1024] @ [1024, 64]: the MXU-shaped reduction
+    a8: [..., la], b8: [..., lb] int32 (entries < 2^8). Returns
+    [..., out_cols] int32 convolution columns."""
+    la, lb = a8.shape[-1], b8.shape[-1]
+    outer = a8[..., :, None] * b8[..., None, :]           # [..., la, lb] VPU
+    flat = outer.reshape(*outer.shape[:-2], la * lb)
+    S = conv_matrix(la, lb, out_cols)
+    # [N, la*lb] @ [la*lb, out]: the MXU-shaped reduction
     return jax.lax.dot_general(
         flat, S, (((flat.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
+
+
+def _mul_columns(a8, b8, full: bool):
+    return mul_columns(a8, b8, 2 * L8 if full else L8)
 
 
 def mont_mul(ctx: F.FieldCtx, a, b):

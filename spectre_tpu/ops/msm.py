@@ -79,6 +79,40 @@ def msm_mode() -> str:
     return mode
 
 
+MSM_IMPLS = ("xla", "pallas")
+
+
+def msm_impl() -> str:
+    """Active MSM implementation from SPECTRE_MSM_IMPL (default: xla).
+
+    `pallas` routes the vanilla mode's bucket loop through the fused SoA
+    complete-add kernel (`ops/msm_pallas.py`; interpret-mode off-TPU).
+    Non-vanilla modes keep the XLA path — the GLV/fixed digit plumbing is
+    AoS — and record a degrade event so provenance shows the fallback."""
+    impl = os.environ.get("SPECTRE_MSM_IMPL", "xla")
+    if impl not in MSM_IMPLS:
+        raise ValueError(
+            f"SPECTRE_MSM_IMPL={impl!r}: expected one of {MSM_IMPLS}")
+    return impl
+
+
+def window_override() -> int | None:
+    """Operator window override from SPECTRE_MSM_WINDOW (1..13, empty/unset
+    = autotuned table). The device retuning knob: `bench.py --sweep-window`
+    emits per-c points/s so a real-TPU run can pick the value, and every
+    `default_window*` consumer (ops/msm.py, parallel/batch_msm.py,
+    plonk/backend.py) honors it without plumbing c by hand."""
+    v = os.environ.get("SPECTRE_MSM_WINDOW")
+    if v is None or v == "":
+        return None
+    c = int(v)
+    if not 1 <= c <= 13:
+        raise ValueError(
+            f"SPECTRE_MSM_WINDOW={v}: expected 1..13 (c > 13 OOMs the "
+            "bucket aggregation — see default_window)")
+    return c
+
+
 def _digits_traced(scalars, w, c: int):
     """Extract window-w c-bit digits from [n, L] 16-bit limb scalars; w may
     be a traced int32 (used inside lax loops). Width-generic — see
@@ -501,7 +535,11 @@ def default_window(n: int, signed: bool = False) -> int:
     materializes [nwin, c, nbuckets, 3, 16]); 13 is the practical ceiling.
     With signed digits the bucket array is 2^(c-1)+1 — the aggregation and
     emission terms that cap c relax by one bucket-doubling, so each size
-    class affords a larger window (pinned by tests/test_msm_modes.py)."""
+    class affords a larger window (pinned by tests/test_msm_modes.py).
+    SPECTRE_MSM_WINDOW overrides the whole table (device retuning)."""
+    ov = window_override()
+    if ov is not None:
+        return ov
     if signed:
         if n >= 1 << 18:
             return 13
@@ -541,10 +579,17 @@ def msm(points, scalars, c: int | None = None, mode: str | None = None,
     if mode not in MSM_MODES:
         raise ValueError(f"unknown MSM mode {mode!r}")
     n = points.shape[0]
+    impl = msm_impl()
     if mode == "vanilla":
         if c is None:
             c = default_window(n)
+        if impl == "pallas":
+            from . import msm_pallas as MP
+            return MP.msm_soa(MP.to_soa(points), scalars, c)
         return combine_windows(msm_windows(points, scalars, c), c)
+    if impl == "pallas":
+        # GLV/fixed digit plumbing is AoS-only: degrade to XLA, visibly
+        _record_event("msm_pallas_unsupported_mode", mode=mode)
 
     from . import glv
     nbits = glv.glv_bits()

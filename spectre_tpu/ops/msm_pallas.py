@@ -20,6 +20,7 @@ redesign, no code lineage.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -35,7 +36,16 @@ LANE = 128
 _P_LIMBS = tuple(int(v) for v in F.fq_ctx().p_limbs)   # BN254 Fq
 _N0 = np.uint32(F.fq_ctx().n0inv16)
 
-_INTERPRET = False     # set True for CPU debugging of the kernel
+
+def _interpret() -> bool:
+    """Run the Pallas kernel in interpret mode off-TPU: Mosaic only lowers
+    for real TPU targets, so every other backend (the CPU CI box included)
+    gets the exact-arithmetic interpreter — same kernel body, same bytes,
+    pinned against the jnp path by tests. SPECTRE_PALLAS_INTERPRET=1 forces
+    it on TPU too (kernel debugging)."""
+    if os.environ.get("SPECTRE_PALLAS_INTERPRET") == "1":
+        return True
+    return jax.default_backend() != "tpu"
 
 
 # ---------------------------------------------------------------------------
@@ -72,7 +82,16 @@ def inf_soa(n: int):
 # ---------------------------------------------------------------------------
 
 def _p_col():
-    return jnp.asarray(np.array(_P_LIMBS, np.uint32))[:, None]
+    """[16, 1] modulus column, built IN-TRACE from scalar literals: a
+    pallas kernel body may not capture traced array constants (pallas_call
+    rejects the jaxpr), so the column is materialized with 16 selects over
+    an iota — free next to a CIOS scan, and the same code path serves the
+    plain-jit uses of the _k_* helpers."""
+    idx = jax.lax.broadcasted_iota(jnp.uint32, (NL, 1), 0)
+    col = jnp.zeros((NL, 1), jnp.uint32)
+    for i, v in enumerate(_P_LIMBS):
+        col = jnp.where(idx == np.uint32(i), np.uint32(v), col)
+    return col
 
 
 def _k_mont_mul(a, b):
@@ -185,8 +204,8 @@ def _padd_kernel(p_ref, q_ref, o_ref):
     o_ref[:, :] = _k_padd(p_ref[:, :], q_ref[:, :])
 
 
-@functools.partial(jax.jit, static_argnames=("block",))
-def _padd_soa_call(p, q, block: int):
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _padd_soa_call(p, q, block: int, interpret: bool):
     from jax.experimental import pallas as pl
 
     n = p.shape[1]
@@ -200,8 +219,20 @@ def _padd_soa_call(p, q, block: int):
             pl.BlockSpec((ROWS, block), lambda i: (0, i)),
         ],
         out_specs=pl.BlockSpec((ROWS, block), lambda i: (0, i)),
-        interpret=_INTERPRET,
+        interpret=interpret,
     )(p, q)
+
+
+def _legal_block(n_pad: int, want: int) -> int:
+    """Largest Mosaic-legal lane-block ≤ want: a multiple of LANE that
+    divides n_pad (n_pad is lane-padded, so LANE itself always qualifies —
+    the search can't fall below a legal shape). The sublane dim is the fixed
+    ROWS=48 = 6 packed uint32 sublane tiles, legal by construction."""
+    q = n_pad // LANE
+    d = min(max(want // LANE, 1), q)
+    while q % d:
+        d -= 1
+    return d * LANE
 
 
 def padd_soa(p, q, block: int = 2048):
@@ -213,10 +244,7 @@ def padd_soa(p, q, block: int = 2048):
         pad = ((0, 0), (0, n_pad - n))
         p = jnp.pad(p, pad)
         q = jnp.pad(q, pad)
-    block = min(block, n_pad)
-    while n_pad % block:
-        block //= 2
-    out = _padd_soa_call(p, q, block)
+    out = _padd_soa_call(p, q, _legal_block(n_pad, block), _interpret())
     return out[:, :n] if n_pad != n else out
 
 

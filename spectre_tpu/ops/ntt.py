@@ -36,6 +36,23 @@ rebuilt as a moded, batched pipeline mirroring the MSM-modes design
   `(kind, k, omega/g)`. A long-running prover service touching many
   circuit sizes must not grow host memory per size it ever saw; eviction
   costs recompute time, never correctness.
+* **`SPECTRE_NTT_KERNEL=stages|matmul`** — selects the BODY of the
+  fourstep short row/col transforms. `stages` is the butterfly kernel
+  above; `matmul` computes each short transform as a direct DFT matrix
+  product in the 8-bit-limb domain (arXiv:2604.17808's MXU mapping,
+  reusing `field_mxu.py`'s one-hot-reduction formulation): the per-length
+  twiddle matrix W[k,j] = omega^{jk} is precomputed in limb form
+  (LRU-budgeted), `sum_j W[k,j]*x[j]` contracts over the point axis with
+  one `dot_general(..., preferred_element_type=int32)`, and the columns
+  are carry-propagated and Montgomery-reduced ONCE per matrix product —
+  log n sequential gather stages become two batched matmuls plus the
+  twiddle/transpose step. Exact-arithmetic reduction radix is 2^264 (one
+  extra 8-bit limb of headroom), which fully reduces sums of up to 1024
+  products in a single REDC; short transforms longer than 2^10 fall back
+  to `stages` (the int32 column budget C·L·255² and the single-REDC bound
+  n·p²/2^264 < p both cap out there). Byte-identical to `stages` (pinned
+  by tests/test_ntt_kernels.py); CPU is slower — the MXU win is the
+  point, see BASELINE.md.
 """
 
 from __future__ import annotations
@@ -54,9 +71,18 @@ from .msm import _TableLRU, _record_event
 R = bn254.R
 
 NTT_MODES = ("radix2", "fourstep")
+NTT_KERNELS = ("stages", "matmul")
 
 # fourstep needs at least one row stage and one column stage
 _FOURSTEP_MIN_LOGN = 2
+
+# Longest short transform the matmul kernel accepts. Two independent budgets
+# pin the same cap: the one-hot reduction's int32 column bound C·L·255² =
+# 1024·32·255² = 2,131,230,720 < 2^31-1 (exactly fits at n=1024, overflows at
+# 2048), and the single-REDC full-reduction bound n·p²/2^264 < p (u < 2p so
+# one conditional subtract canonicalizes; fails for n > 1024). Fourstep short
+# legs are ~sqrt(n_ext), so this covers every domain up to n_ext = 2^20.
+_MATMUL_MAX_LOGN = 10
 
 
 def ntt_mode() -> str:
@@ -70,6 +96,17 @@ def ntt_mode() -> str:
     return mode
 
 
+def ntt_kernel() -> str:
+    """Active short-transform kernel from SPECTRE_NTT_KERNEL (default:
+    stages). Read per call, like `ntt_mode` — the jitted entries key on it
+    as a static argument."""
+    kern = os.environ.get("SPECTRE_NTT_KERNEL", "stages")
+    if kern not in NTT_KERNELS:
+        raise ValueError(
+            f"SPECTRE_NTT_KERNEL={kern!r}: expected one of {NTT_KERNELS}")
+    return kern
+
+
 def _resolve_mode(mode: str | None, logn: int) -> str:
     m = mode if mode is not None else ntt_mode()
     if m not in NTT_MODES:
@@ -77,6 +114,18 @@ def _resolve_mode(mode: str | None, logn: int) -> str:
     if m == "fourstep" and logn < _FOURSTEP_MIN_LOGN:
         return "radix2"              # nothing to split
     return m
+
+
+def _resolve_kernel(kernel: str | None, mode: str) -> str:
+    """The kernel knob selects the BODY of the fourstep short transforms;
+    radix2 has no short transforms, so normalize to "stages" there to keep
+    trace cache keys stable when the env flips."""
+    k = kernel if kernel is not None else ntt_kernel()
+    if k not in NTT_KERNELS:
+        raise ValueError(f"unknown NTT kernel {k!r}")
+    if mode != "fourstep":
+        return "stages"
+    return k
 
 
 # ---------------------------------------------------------------------------
@@ -227,6 +276,140 @@ def _fused_out_table(logn: int, g: int | None, std: bool) -> np.ndarray:
     return _TABLES.put(key, None, tab)
 
 
+def _vinv_in_table(logn: int, vals: tuple) -> np.ndarray:
+    """Stage-0 pre-scale table for the fused quotient vanishing-inverse:
+    encode(vals[i % len(vals)]) tiled over the domain. The extended-domain
+    vanishing polynomial has only EXTENSION distinct values, so the caller
+    passes the short period tuple (hashable → usable as a static jit arg)
+    and the full [n, 16] Montgomery table materializes here, LRU-budgeted
+    like every other per-size table."""
+    key = ("vinv", logn, vals)
+    hit = _TABLES.get(key, None)
+    if hit is not None:
+        return hit
+    ctx = F.fr_ctx()
+    n = 1 << logn
+    per = len(vals)
+    return _TABLES.put(key, None,
+                       ctx.encode([vals[i % per] for i in range(n)]))
+
+
+# ---------------------------------------------------------------------------
+# matmul kernel: short transforms as DFT matrix products in the limb domain
+# ---------------------------------------------------------------------------
+
+# Reduction radix for the matmul kernel's single REDC: one extra 8-bit limb
+# over the 2^256 Montgomery radix. W entries carry the compensating 2^264
+# factor, so after dividing by 2^264 the result is back in plain Montgomery
+# form (factor R = 2^256) and byte-identical to the stages kernel.
+_REDC_SHIFT = 264
+_REDC_LIMBS = _REDC_SHIFT // 8               # 33
+
+
+@functools.cache
+def _matmul_consts():
+    """(p' = -p^{-1} mod 2^264 as 33 limbs, p as 32 limbs), int32 8-bit."""
+    p = F.fr_ctx().p
+    r1 = 1 << _REDC_SHIFT
+    pinv = (-pow(p, -1, r1)) % r1
+    pinv8 = np.array([(pinv >> (8 * i)) & 0xFF for i in range(_REDC_LIMBS)],
+                     dtype=np.int32)
+    p8 = np.array([(p >> (8 * i)) & 0xFF for i in range(32)], dtype=np.int32)
+    return pinv8, p8
+
+
+def _dft_matrix8(logn: int, omega: int) -> np.ndarray:
+    """8-bit-limb DFT matrix for the matmul kernel, contraction-ready:
+    Wt[j, k*32 + i] = limb i of (omega^{jk} · 2^264 mod p), uint8 [n, n*32].
+    One dot_general contracting the point axis j then yields every output
+    point's raw limb-pair products in one MXU-shaped matmul. LRU-budgeted
+    (uint8 keeps the n=1024 table at 32 MB host-side)."""
+    key = ("dft8", logn, omega)
+    hit = _TABLES.get(key, None)
+    if hit is not None:
+        return hit
+    p = F.fr_ctx().p
+    n = 1 << logn
+    shift = (1 << _REDC_SHIFT) % p
+    out = np.empty((n, n, 32), dtype=np.uint8)
+    for j in range(n):
+        w = pow(omega, j, p)
+        acc = shift
+        row = out[j]
+        for k in range(n):
+            row[k] = np.frombuffer(acc.to_bytes(32, "little"), np.uint8)
+            acc = acc * w % p
+    return _TABLES.put(key, None, out.reshape(n, n * 32))
+
+
+def _ntt_dft_matmul(a, logn: int, omega: int):
+    """Direct DFT of axis -2 of a [..., n, 16] Montgomery limb tensor as one
+    limb-domain matrix product (the arXiv:2604.17808 MXU mapping):
+
+        T[k] = sum_j (omega^{jk}·2^264) · x_j  <  n·p²     (exact, int32 cols)
+        out[k] = REDC_264(T[k])                            (one reduction)
+
+    The point-axis contraction is ONE dot_general against the precomputed
+    [n, n*32] twiddle-limb matrix; the limb-pair products then collapse to
+    convolution columns through `field_mxu.conv_matrix`'s one-hot matmul.
+    Each column is bounded by C·L·255² = n·32·255² ≤ 2,131,230,720 < 2^31-1
+    (the `_MATMUL_MAX_LOGN` budget), and a single 2^264-radix REDC fully
+    reduces: u < n·p²/2^264 + p < 2p, one conditional subtract canonicalizes.
+    Canonical in, canonical out — byte-identical to `_ntt_stages`."""
+    from . import field_mxu as MX
+
+    ctx = F.fr_ctx()
+    n = 1 << logn
+    pinv8, p8 = _matmul_consts()
+    wt = jnp.asarray(_dft_matrix8(logn, omega)).astype(jnp.int32)
+
+    x8 = MX._to8(a)                           # [..., n, 32] int32, limbs i2
+    # G[..., k, i1, i2] = sum_j Wt[j, (k,i1)] * x8[..., j, i2]: the one
+    # point-axis dot_general (batch, then lhs free i2, then rhs free (k,i1))
+    g = jax.lax.dot_general(
+        x8, wt, (((x8.ndim - 2,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)     # [..., i2, n*32]
+    g = g.reshape(g.shape[:-2] + (MX.L8, n, MX.L8))   # [..., i2, k, i1]
+    g = jnp.moveaxis(g, -3, -1)               # [..., k, i1, i2]
+    flat = g.reshape(g.shape[:-2] + (MX.L8 * MX.L8,))
+    s = MX.conv_matrix(MX.L8, MX.L8, 63)      # columns of a 32x32 conv
+    t_cols = jax.lax.dot_general(
+        flat, s, (((flat.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)     # [..., k, 63] < C·L·255²
+
+    # REDC with radix 2^264: t < n·p² < 2^518 needs 65 8-bit limbs
+    t8 = MX._carry8(t_cols, 65)
+    t_lo = t8[..., :_REDC_LIMBS]
+    m_cols = MX.mul_columns(t_lo, jnp.asarray(pinv8), _REDC_LIMBS)
+    m8 = MX._carry8(m_cols, _REDC_LIMBS)      # m = t·p' mod 2^264
+    mp_cols = MX.mul_columns(m8, jnp.asarray(p8), 65)
+
+    # low 33 limbs of t + m·p are 0 mod 2^264 by construction: propagate
+    # them only for the carry into the high half (carry ≤ 1)
+    low_sum = t_lo + mp_cols[..., :_REDC_LIMBS]
+    low_t = jnp.moveaxis(low_sum, -1, 0)
+
+    def step(carry, ti):
+        cur = ti + carry
+        return cur >> 8, cur & jnp.int32(0xFF)
+
+    carry_low, _ = jax.lax.scan(step, jnp.zeros_like(low_t[0]), low_t)
+
+    hi_cols = mp_cols[..., _REDC_LIMBS:] + t8[..., _REDC_LIMBS:]
+    hi_cols = hi_cols.at[..., 0].add(carry_low)
+    u8 = MX._carry8(hi_cols, 32)              # u < 2p < 2^255
+    res16 = MX._from8(u8.astype(jnp.uint32))
+    return F._cond_sub_p(ctx, res16)
+
+
+def _short_transform(a, logn: int, omega: int, kernel: str):
+    """Fourstep row/col transform body: butterfly stages or the DFT-matmul.
+    Lengths past the matmul's exactness budget fall back to stages."""
+    if kernel == "matmul" and 0 < logn <= _MATMUL_MAX_LOGN:
+        return _ntt_dft_matmul(a, logn, omega)
+    return _ntt_stages(a, logn, omega)
+
+
 # ---------------------------------------------------------------------------
 # core transforms (shape-generic over leading batch axes)
 # ---------------------------------------------------------------------------
@@ -262,14 +445,16 @@ def _ntt_stages(a, logn: int, omega: int, scale=None):
     return a
 
 
-def _ntt_fourstep(a, logn: int, omega: int, scale=None):
+def _ntt_fourstep(a, logn: int, omega: int, scale=None,
+                  kernel: str = "stages"):
     """Single-device four-step (Bailey) NTT of [..., n, 16]: view x as an
     Rr x Cc matrix (A[jr, jc] = x[jc*Rr + jr]), length-Cc row NTTs, the
     omega^(jr*kc) twiddle multiply, a transpose, then length-Rr row NTTs —
     the exact decomposition `parallel/sharded_ntt.py` shards over a mesh,
     here kept on one device: log n sequential full-array gather stages
     become two batches of short NTTs plus one MXU-shaped elementwise +
-    transpose step. Output is natural order, byte-identical to radix2."""
+    transpose step. `kernel` picks the short-transform body (butterflies or
+    the DFT matmul). Output is natural order, byte-identical to radix2."""
     ctx = F.fr_ctx()
     logr = logn // 2
     logc = logn - logr
@@ -286,17 +471,18 @@ def _ntt_fourstep(a, logn: int, omega: int, scale=None):
         if s.shape[0] == (1 << logn):
             s = np.moveaxis(s.reshape(cc, rr, F.NLIMBS), -2, -3)
         A = F.mont_mul(ctx, A, jnp.asarray(s))
-    y = _ntt_stages(A, logc, omega_row)      # step 1: row NTTs (rr batched)
+    y = _short_transform(A, logc, omega_row, kernel)  # step 1: row NTTs
     y = F.mont_mul(ctx, y, jnp.asarray(tw))  # step 2: twiddle
     y = jnp.moveaxis(y, -2, -3)              # step 3: transpose
-    y = _ntt_stages(y, logr, omega_col)      # step 4: column NTTs
+    y = _short_transform(y, logr, omega_col, kernel)  # step 4: column NTTs
     # y[kc, kr] = X[kr*cc + kc] -> natural order
     return jnp.moveaxis(y, -2, -3).reshape(a.shape)
 
 
-def _ntt_nd(a, logn: int, omega: int, scale=None, mode: str = "radix2"):
+def _ntt_nd(a, logn: int, omega: int, scale=None, mode: str = "radix2",
+            kernel: str = "stages"):
     if mode == "fourstep":
-        return _ntt_fourstep(a, logn, omega, scale)
+        return _ntt_fourstep(a, logn, omega, scale, kernel)
     return _ntt_stages(a, logn, omega, scale)
 
 
@@ -331,8 +517,8 @@ def _batch_rows(a, body):
     return body(a)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
-def _fwd_kernel(a, omega: int, in_kind, mode: str):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def _fwd_kernel(a, omega: int, in_kind, mode: str, kernel: str = "stages"):
     """in_kind: None (mont input, no scale), ("mont", g) fused coset
     pre-scale on a Montgomery input, ("std", g_or_None) standard-form input
     with the boundary conversion (+ optional coset scale) fused in."""
@@ -343,19 +529,26 @@ def _fwd_kernel(a, omega: int, in_kind, mode: str):
         scale = _power_table(logn, in_kind[1])
     else:
         scale = _fused_in_table(logn, in_kind[1])
-    return _batch_rows(a, lambda row: _ntt_nd(row, logn, omega, scale, mode))
+    return _batch_rows(
+        a, lambda row: _ntt_nd(row, logn, omega, scale, mode, kernel))
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
-def _inv_kernel(a, omega: int, g, std: bool, mode: str):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
+def _inv_kernel(a, omega: int, g, std: bool, mode: str,
+                kernel: str = "stages", pre: tuple | None = None):
     """Inverse transform of [..., n, 16]: forward with omega^{-1}, then ONE
-    fused multiply by the combined (1/n, g^{-i}, mont→std) table."""
+    fused multiply by the combined (1/n, g^{-i}, mont→std) table. `pre` (a
+    hashable tuple of host ints, period-tiled over the domain) folds an
+    elementwise pre-multiply — the quotient's vanishing-inverse — into
+    stage 0 of the inverse transform, exactly like the forward coset
+    fusions: same mont_mul, same canonical result as a separate pass."""
     logn = _logn_of(a)
     omega_inv = pow(omega, -1, R)
     tab = _fused_out_table(logn, g, std)
+    scale = _vinv_in_table(logn, pre) if pre is not None else None
 
     def body(row):
-        res = _ntt_nd(row, logn, omega_inv, None, mode)
+        res = _ntt_nd(row, logn, omega_inv, scale, mode, kernel)
         return F.mont_mul(F.fr_ctx(), res, jnp.asarray(tab))
 
     return _batch_rows(a, body)
@@ -365,73 +558,109 @@ def _inv_kernel(a, omega: int, g, std: bool, mode: str):
 # public API
 # ---------------------------------------------------------------------------
 
-def ntt(a: jax.Array, omega: int, mode: str | None = None) -> jax.Array:
+def ntt(a: jax.Array, omega: int, mode: str | None = None,
+        kernel: str | None = None) -> jax.Array:
     """NTT of a [n, 16] Montgomery limb tensor; returns evaluations in
     natural order. omega must be a primitive n-th root of unity (host int).
-    mode defaults to SPECTRE_NTT_MODE (see `ntt_mode`)."""
-    return _fwd_kernel(a, omega, None, _resolve_mode(mode, _logn_of(a)))
+    mode defaults to SPECTRE_NTT_MODE (see `ntt_mode`); kernel — the
+    fourstep short-transform body — to SPECTRE_NTT_KERNEL."""
+    m = _resolve_mode(mode, _logn_of(a))
+    return _fwd_kernel(a, omega, None, m, _resolve_kernel(kernel, m))
 
 
-def ntt_many(a: jax.Array, omega: int, mode: str | None = None) -> jax.Array:
+def ntt_many(a: jax.Array, omega: int, mode: str | None = None,
+             kernel: str | None = None) -> jax.Array:
     """Batched NTT of a [B, n, 16] stack in one compiled kernel: every
     butterfly stage processes all B polynomials with shared twiddles."""
-    return _fwd_kernel(a, omega, None, _resolve_mode(mode, _logn_of(a)))
+    m = _resolve_mode(mode, _logn_of(a))
+    return _fwd_kernel(a, omega, None, m, _resolve_kernel(kernel, m))
 
 
-def intt(a: jax.Array, omega: int, mode: str | None = None) -> jax.Array:
+def intt(a: jax.Array, omega: int, mode: str | None = None,
+         kernel: str | None = None) -> jax.Array:
     """Inverse NTT: forward with omega^{-1}, then scale by n^{-1}."""
-    return _inv_kernel(a, omega, None, False,
-                       _resolve_mode(mode, _logn_of(a)))
+    m = _resolve_mode(mode, _logn_of(a))
+    return _inv_kernel(a, omega, None, False, m,
+                       _resolve_kernel(kernel, m), None)
 
 
-def intt_many(a: jax.Array, omega: int, mode: str | None = None) -> jax.Array:
+def intt_many(a: jax.Array, omega: int, mode: str | None = None,
+              kernel: str | None = None) -> jax.Array:
     """Batched inverse NTT of a [B, n, 16] stack (see `ntt_many`)."""
-    return _inv_kernel(a, omega, None, False,
-                       _resolve_mode(mode, _logn_of(a)))
+    m = _resolve_mode(mode, _logn_of(a))
+    return _inv_kernel(a, omega, None, False, m,
+                       _resolve_kernel(kernel, m), None)
 
 
-def coset_ntt(a: jax.Array, omega: int, g: int,
-              mode: str | None = None) -> jax.Array:
+def coset_ntt(a: jax.Array, omega: int, g: int, mode: str | None = None,
+              kernel: str | None = None) -> jax.Array:
     """Fused coset-LDE: evaluations of a on g*<omega> in ONE kernel — the
     g^i pre-scale rides stage 0 of the NTT instead of a separate pass."""
-    return _fwd_kernel(a, omega, ("mont", g),
-                       _resolve_mode(mode, _logn_of(a)))
+    m = _resolve_mode(mode, _logn_of(a))
+    return _fwd_kernel(a, omega, ("mont", g), m, _resolve_kernel(kernel, m))
 
 
-def coset_intt(a: jax.Array, omega: int, g: int,
-               mode: str | None = None) -> jax.Array:
+def coset_intt(a: jax.Array, omega: int, g: int, mode: str | None = None,
+               kernel: str | None = None) -> jax.Array:
     """Fused inverse coset-LDE: one combined g^{-i}·n^{-1} multiply after
     the inverse transform (two elementwise passes become one)."""
-    return _inv_kernel(a, omega, g, False, _resolve_mode(mode, _logn_of(a)))
+    m = _resolve_mode(mode, _logn_of(a))
+    return _inv_kernel(a, omega, g, False, m,
+                       _resolve_kernel(kernel, m), None)
 
 
 def coset_ntt_many(a: jax.Array, omega: int, g: int,
-                   mode: str | None = None) -> jax.Array:
+                   mode: str | None = None,
+                   kernel: str | None = None) -> jax.Array:
     """Batched fused coset-LDE over a [B, n, 16] stack."""
-    return _fwd_kernel(a, omega, ("mont", g),
-                       _resolve_mode(mode, _logn_of(a)))
+    m = _resolve_mode(mode, _logn_of(a))
+    return _fwd_kernel(a, omega, ("mont", g), m, _resolve_kernel(kernel, m))
 
 
 def coset_intt_many(a: jax.Array, omega: int, g: int,
-                    mode: str | None = None) -> jax.Array:
-    return _inv_kernel(a, omega, g, False, _resolve_mode(mode, _logn_of(a)))
+                    mode: str | None = None,
+                    kernel: str | None = None) -> jax.Array:
+    m = _resolve_mode(mode, _logn_of(a))
+    return _inv_kernel(a, omega, g, False, m,
+                       _resolve_kernel(kernel, m), None)
 
 
 def coset_lde_std(a_std: jax.Array, omega: int, g: int | None,
-                  mode: str | None = None) -> jax.Array:
+                  mode: str | None = None,
+                  kernel: str | None = None) -> jax.Array:
     """Coset-LDE of STANDARD-form limb input ([..., n, 16]): the std→mont
     boundary conversion and the coset scale fold into one stage-0 table, so
     the whole quotient-phase `to_ext` is a single kernel. Returns Montgomery
     evaluations (the quotient keeps working in Montgomery form)."""
-    return _fwd_kernel(a_std, omega, ("std", g),
-                       _resolve_mode(mode, _logn_of(a_std)))
+    m = _resolve_mode(mode, _logn_of(a_std))
+    return _fwd_kernel(a_std, omega, ("std", g), m,
+                       _resolve_kernel(kernel, m))
 
 
 def coset_intt_std(a: jax.Array, omega: int, g: int | None,
-                   mode: str | None = None) -> jax.Array:
+                   mode: str | None = None,
+                   kernel: str | None = None) -> jax.Array:
     """Inverse coset-LDE emitting STANDARD-form limbs: 1/n, g^{-i} and the
     mont→std conversion are ONE multiply by a raw (un-encoded) table."""
-    return _inv_kernel(a, omega, g, True, _resolve_mode(mode, _logn_of(a)))
+    m = _resolve_mode(mode, _logn_of(a))
+    return _inv_kernel(a, omega, g, True, m,
+                       _resolve_kernel(kernel, m), None)
+
+
+def coset_intt_std_vinv(a: jax.Array, omega: int, g: int | None,
+                        vinv_vals, mode: str | None = None,
+                        kernel: str | None = None) -> jax.Array:
+    """`coset_intt_std(vinv ⊙ a, ...)` with the per-point vanishing-inverse
+    multiply FOLDED into stage 0 of the inverse transform. `vinv_vals` is
+    the short period of host ints tiled over the domain (the extended-domain
+    vanishing inverse has only EXTENSION distinct values — see
+    `plonk.domain.Domain.vanishing_inv_period_vals`). Byte-identical to the
+    explicit multiply-then-transform (both paths are one canonical mont_mul
+    per point), but the quotient h-path issues one fewer full-width
+    elementwise pass per proof."""
+    m = _resolve_mode(mode, _logn_of(a))
+    return _inv_kernel(a, omega, g, True, m, _resolve_kernel(kernel, m),
+                       tuple(int(v) % R for v in vinv_vals))
 
 
 def coset_scale(a: jax.Array, g: int, inverse: bool = False) -> jax.Array:

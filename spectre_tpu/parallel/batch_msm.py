@@ -120,7 +120,11 @@ def batch_msm_dp(points, scalars_batch, c: int | None = None,
     GLV threading (backend.msm_many): pass the endomorphism-EXPANDED base,
     half-scalar magnitudes (L=8), `neg_batch` [B,n] sign masks, and
     nbits=glv.glv_bits(); signed=True routes through the signed-digit
-    kernels (halved buckets)."""
+    kernels (halved buckets).
+
+    Window width: explicit `c` wins; otherwise `MSM.default_window`, which
+    honors the SPECTRE_MSM_WINDOW override before its tuned table — one env
+    knob sweeps every MSM path (bench.py --sweep-window)."""
     n = points.shape[0]
     if c is None:
         c = MSM.default_window(n, signed=signed)

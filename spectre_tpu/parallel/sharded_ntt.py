@@ -56,32 +56,36 @@ _TWIDDLES: dict = {}
 # Extracted from the shard_map closure so the kernel linter can trace them
 # at tiny shapes without a mesh (analysis/kernel_lint known-root table).
 
-def _rows_local(block, twb, omega_row: int, mode: str):
+def _rows_local(block, twb, omega_row: int, mode: str,
+                kernel: str = "stages"):
     """Steps 1-2 on one shard: length-Cc NTT along each local row, then the
     elementwise twiddle multiply. block/twb: [rows_local, Cc, 16]."""
     y = jax.vmap(
         lambda row: NTT._fwd_kernel.__wrapped__(row, omega_row, None,
-                                                mode))(block)
+                                                mode, kernel))(block)
     return F.mont_mul(F.fr_ctx(), y, twb)
 
 
-def _cols_local(y, omega_col: int, mode: str):
+def _cols_local(y, omega_col: int, mode: str, kernel: str = "stages"):
     """Step 4 on one shard: length-Rr NTT along each post-transpose row."""
     return jax.vmap(
         lambda row: NTT._fwd_kernel.__wrapped__(row, omega_col, None,
-                                                mode))(y)
+                                                mode, kernel))(y)
 
 
 def _ntt_runner(plan: ShardingPlan, axis: str, logn: int, omega: int):
     s = plan.mesh.shape[axis]
     logr = logn // 2
     logc = logn - logr
-    # the LOCAL transforms are sqrt(n)-sized; resolve their mode once at
-    # build time and key the cached program on it (the env knob must not
-    # silently go stale inside a resident program)
+    # the LOCAL transforms are sqrt(n)-sized; resolve their mode/kernel once
+    # at build time and key the cached program on them (the env knobs must
+    # not silently go stale inside a resident program)
     row_mode = NTT._resolve_mode(None, logc)
     col_mode = NTT._resolve_mode(None, logr)
-    key = (plan.key, axis, logn, omega, row_mode, col_mode)
+    row_kernel = NTT._resolve_kernel(None, row_mode)
+    col_kernel = NTT._resolve_kernel(None, col_mode)
+    key = (plan.key, axis, logn, omega, row_mode, col_mode,
+           row_kernel, col_kernel)
     hit = _RUNNERS.get(key)
     if hit is not None:
         return hit
@@ -97,12 +101,12 @@ def _ntt_runner(plan: ShardingPlan, axis: str, logn: int, omega: int):
         shard_map, mesh=plan.mesh, in_specs=(spec, spec), out_specs=spec,
         check_vma=False)
     def run(block, twb):
-        y = _rows_local(block, twb, omega_row, row_mode)
+        y = _rows_local(block, twb, omega_row, row_mode, row_kernel)
         # step 3: transpose via all-to-all (split columns, gather rows)
         y = jax.lax.all_to_all(y, axis, split_axis=1, concat_axis=0,
                                tiled=True)              # [rr, cc/s, 16]
         y = y.transpose(1, 0, 2)                        # [cc/s, rr, 16]
-        return _cols_local(y, omega_col, col_mode)
+        return _cols_local(y, omega_col, col_mode, col_kernel)
 
     fn = jax.jit(run)
     if len(_RUNNERS) > 32:

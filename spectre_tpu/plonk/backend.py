@@ -368,7 +368,9 @@ class TpuBackend(CpuBackend):
                 base, plan.place(jnp.asarray(ng), plan.sign_spec))
             ng = np.zeros_like(ng)
         if mode == "vanilla":
-            c = 13 if mp >= (1 << 18) else 10
+            # mesh-tuned static window; SPECTRE_MSM_WINDOW still wins so a
+            # sweep (bench.py --sweep-window) exercises the sharded path too
+            c = MSM.window_override() or (13 if mp >= (1 << 18) else 10)
         else:
             c = MSM.default_window(mp, signed=signed)
         sd = plan.place(jnp.asarray(sc), plan.scalar_spec)

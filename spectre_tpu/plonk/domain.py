@@ -116,6 +116,21 @@ class Domain:
                 bk.inv(self.vanishing_on_extended())
         return hit
 
+    def vanishing_inv_period_vals(self) -> tuple[int, ...]:
+        """The EXTENSION distinct values of 1/((g·omega_ext^i)^n - 1): the
+        extended-domain vanishing inverse tiles these with period EXTENSION.
+        A hashable host-int tuple, so the quotient can hand it to the NTT as
+        a static jit argument and fold the whole [4n, 16] inverse multiply
+        into stage 0 of `coset_intt_std` (ops.ntt.coset_intt_std_vinv)."""
+        hit = self.__dict__.get("_vanish_inv_vals")
+        if hit is None:
+            gn = pow(COSET_GEN, self.n, R)
+            wn = pow(self.omega_ext, self.n, R)  # order-4 root
+            hit = self.__dict__["_vanish_inv_vals"] = tuple(
+                pow((gn * pow(wn, i, R) - 1) % R, -1, R)
+                for i in range(EXTENSION))
+        return hit
+
     def evaluate_vanishing(self, x: int) -> int:
         return (pow(x, self.n, R) - 1) % R
 

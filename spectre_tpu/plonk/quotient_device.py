@@ -32,6 +32,8 @@ arrays, compared in-situ during real proves
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ..fields import bn254
@@ -44,6 +46,14 @@ R = bn254.R
 
 _jit_helpers: dict = {}
 _static_cache: dict = {}
+
+
+def _fused_vinv() -> bool:
+    """SPECTRE_QUOTIENT_FUSED_VINV=0 keeps the explicit [4n, 16] vanishing-
+    inverse mont_mul pass (the pre-fusion path, byte-identical — kept as the
+    oracle for tests/test_ntt_kernels.py). Default: fold it into stage 0 of
+    the inverse coset NTT, one fewer full-width elementwise pass per proof."""
+    return os.environ.get("SPECTRE_QUOTIENT_FUSED_VINV", "1") != "0"
 
 
 def _helpers():
@@ -156,10 +166,12 @@ def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
 
     from ..ops import ntt as NTT
 
-    # per-(cfg, domain) static device inputs: synthetic rows, x column,
-    # vanishing inverse — built once, reused every proof (the coset scale /
-    # unscale tables now live inside ops/ntt.py's budgeted table LRU as
-    # part of the fused kernels)
+    # per-(cfg, domain) static device inputs: synthetic rows, x column —
+    # built once, reused every proof (the coset scale / unscale tables now
+    # live inside ops/ntt.py's budgeted table LRU as part of the fused
+    # kernels, and the vanishing inverse rides the fused inverse path as a
+    # stage-0 table; the explicit [4n, 16] tensor materializes lazily only
+    # when SPECTRE_QUOTIENT_FUSED_VINV=0)
     n, m = dom.n, dom.n_ext
     ck = (cfg, dom.k)
     st = _static_cache.get(ck)
@@ -173,8 +185,6 @@ def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
         st = {
             "xcol": mont_of([COSET_GEN * pow(dom.omega_ext, i, R) % R
                              for i in range(m)]),
-            "vinv": to_mont16(jnp.asarray(L16.u64limbs_to_u16limbs(
-                dom.vanishing_inv_on_extended()))),
             "l0": row_of([0]),
             "llast": row_of([cfg.last_row]),
             "lblind": row_of(range(cfg.usable_rows + 1, n)),
@@ -236,8 +246,17 @@ def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
     if acc is None:
         raise ValueError("config yields no constraint expressions — "
                          "nothing to fold into a quotient")
-    # h = acc / Z_H on the coset, then the fused inverse path: ONE kernel
-    # (iNTT + combined g^{-i}·n^{-1} unscale + mont→std boundary table)
-    hacc = h["mul"](acc, st["vinv"])
-    std = NTT.coset_intt_std(hacc, dom.omega_ext, COSET_GEN)
+    # h = acc / Z_H on the coset, then the fused inverse path: ONE kernel —
+    # the 1/Z_H stage-0 pre-scale, the iNTT, and the combined
+    # g^{-i}·n^{-1}·(mont→std) output table all ride a single transform
+    if _fused_vinv():
+        std = NTT.coset_intt_std_vinv(acc, dom.omega_ext, COSET_GEN,
+                                      dom.vanishing_inv_period_vals())
+    else:
+        vinv = st.get("vinv")
+        if vinv is None:
+            vinv = st["vinv"] = to_mont16(jnp.asarray(
+                L16.u64limbs_to_u16limbs(dom.vanishing_inv_on_extended())))
+        hacc = h["mul"](acc, vinv)
+        std = NTT.coset_intt_std(hacc, dom.omega_ext, COSET_GEN)
     return L16.u16limbs_to_u64limbs(np.asarray(std))

@@ -198,3 +198,103 @@ class TestDefaultWindowTuning:
         for n in (1 << 7, 1 << 12, 1 << 17, 1 << 20):
             assert MSM.default_window_fixed(n) == \
                 MSM.default_window(n, signed=True)
+
+
+class TestWindowOverride:
+    """SPECTRE_MSM_WINDOW: one env knob retunes every MSM path (the value
+    a bench.py --sweep-window run picks on real hardware)."""
+
+    def test_override_wins_over_tables(self, monkeypatch):
+        monkeypatch.setenv("SPECTRE_MSM_WINDOW", "9")
+        assert MSM.window_override() == 9
+        for n in (1 << 6, 1 << 12, 1 << 18):
+            assert MSM.default_window(n) == 9
+            assert MSM.default_window(n, signed=True) == 9
+            assert MSM.default_window_fixed(n) == 9
+
+    def test_unset_and_empty_mean_autotune(self, monkeypatch):
+        monkeypatch.delenv("SPECTRE_MSM_WINDOW", raising=False)
+        assert MSM.window_override() is None
+        monkeypatch.setenv("SPECTRE_MSM_WINDOW", "")
+        assert MSM.window_override() is None
+        assert MSM.default_window(1 << 12) == 10     # table still pinned
+
+    @pytest.mark.parametrize("bad", ["0", "14", "-3"])
+    def test_out_of_range_rejected(self, bad, monkeypatch):
+        monkeypatch.setenv("SPECTRE_MSM_WINDOW", bad)
+        with pytest.raises(ValueError):
+            MSM.window_override()
+
+    def test_override_result_unchanged(self, monkeypatch):
+        """An overridden window changes the work shape, never the point."""
+        pts = ec.encode_points(
+            [bn.g1_curve.mul(bn.G1_GEN, 3 * k + 1) for k in range(8)])
+        ss = jnp.asarray(L.ints_to_limbs16([k * 5 + 2 for k in range(8)]))
+        want = np.asarray(MSM.msm(pts, ss, mode="vanilla"))
+        monkeypatch.setenv("SPECTRE_MSM_WINDOW", "3")
+        got = np.asarray(MSM.msm(pts, ss, mode="vanilla"))
+        assert ec.decode_points(jnp.asarray(got)[None]) == \
+            ec.decode_points(jnp.asarray(want)[None])
+
+
+class TestImplDispatch:
+    """SPECTRE_MSM_IMPL: xla (default) vs the pallas SoA kernel path."""
+
+    def test_env_validation(self, monkeypatch):
+        monkeypatch.delenv("SPECTRE_MSM_IMPL", raising=False)
+        assert MSM.msm_impl() == "xla"
+        monkeypatch.setenv("SPECTRE_MSM_IMPL", "pallas")
+        assert MSM.msm_impl() == "pallas"
+        monkeypatch.setenv("SPECTRE_MSM_IMPL", "cuda")
+        with pytest.raises(ValueError):
+            MSM.msm_impl()
+
+    def test_pallas_routes_vanilla(self, monkeypatch):
+        from spectre_tpu.ops import msm_pallas as MP
+        calls = []
+        sentinel = jnp.zeros((3, 16), dtype=jnp.uint32)
+        monkeypatch.setattr(
+            MP, "msm_soa",
+            lambda soa, sc, c: calls.append((soa.shape, int(c))) or sentinel)
+        monkeypatch.setenv("SPECTRE_MSM_IMPL", "pallas")
+        pts = ec.encode_points(
+            [bn.g1_curve.mul(bn.G1_GEN, k + 1) for k in range(4)])
+        ss = jnp.asarray(L.ints_to_limbs16([k + 1 for k in range(4)]))
+        out = MSM.msm(pts, ss, c=4, mode="vanilla")
+        assert out is sentinel
+        assert calls == [((MP.ROWS, 4), 4)]
+
+    def test_pallas_nonvanilla_degrades_to_xla(self, monkeypatch):
+        """GLV/fixed plumbing is AoS-only: pallas impl must fall through to
+        the XLA path AND leave a provenance event, not fail or go wrong."""
+        events = []
+        monkeypatch.setattr(
+            MSM, "_record_event",
+            lambda kind, **detail: events.append((kind, detail)))
+        pts = ec.encode_points(
+            [bn.g1_curve.mul(bn.G1_GEN, 2 * k + 1) for k in range(6)])
+        ss = jnp.asarray(L.ints_to_limbs16([k * 7 + 3 for k in range(6)]))
+        want = ec.decode_points(
+            jnp.asarray(MSM.msm(pts, ss, mode="glv"))[None])
+        monkeypatch.setenv("SPECTRE_MSM_IMPL", "pallas")
+        got = ec.decode_points(
+            jnp.asarray(MSM.msm(pts, ss, mode="glv"))[None])
+        assert got == want
+        assert ("msm_pallas_unsupported_mode", {"mode": "glv"}) in events
+
+    def test_pallas_vanilla_matches_xla_interpret(self, monkeypatch):
+        """End-to-end impl parity THROUGH the real interpret-mode pallas
+        kernel on a tiny instance."""
+        import os
+        if os.environ.get("RUN_SLOW") != "1":
+            pytest.skip("interpret-mode MSM compiles many shapes "
+                        "(set RUN_SLOW=1)")
+        pts = ec.encode_points(
+            [bn.g1_curve.mul(bn.G1_GEN, k + 2) for k in range(8)])
+        ss = jnp.asarray(L.ints_to_limbs16([k * 3 + 1 for k in range(8)]))
+        want = ec.decode_points(
+            jnp.asarray(MSM.msm(pts, ss, c=4, mode="vanilla"))[None])
+        monkeypatch.setenv("SPECTRE_MSM_IMPL", "pallas")
+        got = ec.decode_points(
+            jnp.asarray(MSM.msm(pts, ss, c=4, mode="vanilla"))[None])
+        assert got == want

@@ -1,7 +1,9 @@
 """Pallas MSM kernel math: the in-kernel field/EC functions are pure jnp on
 limb-row lists, so they are testable WITHOUT pallas_call (Mosaic needs real
-TPU; interpret mode is minutes-slow per call). Everything goes through jit —
-eager execution of the ~30k-op unrolled kernels costs minutes per call.
+TPU). Everything goes through jit — eager execution of the ~30k-op unrolled
+kernels costs minutes per call. ONE small-shape test runs the actual
+pallas_call in interpret mode (seconds-scale compile, the off-TPU dispatch
+SPECTRE_MSM_IMPL=pallas rides) — see TestInterpretMode.
 
 Oracle: ops/ec (already property-tested against the host curve). The full
 SoA MSM parity run is RUN_SLOW (several compile shapes); device execution of
@@ -91,6 +93,40 @@ class TestKernelMath:
         assert np.array_equal(np.asarray(got), want)
         got2 = MP.from_soa(_jit_padd(MP.to_soa(a), MP.to_soa(inf)))
         assert ec.decode_points(got2) == ec.decode_points(a)
+
+
+class TestLegalBlock:
+    def test_lane_multiple_dividing_pad(self):
+        # largest multiple of LANE that divides n_pad, capped at `want`
+        assert MP._legal_block(128, 2048) == 128
+        assert MP._legal_block(256, 2048) == 256
+        assert MP._legal_block(384, 256) == 128     # 256 doesn't divide 384
+        assert MP._legal_block(4096, 2048) == 2048
+        assert MP._legal_block(4096, 100) == 128    # floor is one lane tile
+        for n_pad in (128, 384, 1152, 4096):
+            b = MP._legal_block(n_pad, 2048)
+            assert b % MP.LANE == 0 and n_pad % b == 0
+
+
+class TestInterpretMode:
+    """The REAL pallas_call in interpret mode (auto-selected off-TPU): one
+    small shape — the kernel body is already covered by TestKernelMath;
+    this pins the pallas_call plumbing (BlockSpecs, grid, the in-trace
+    modulus column) against the same ec.padd oracle."""
+
+    def test_interpret_dispatch_off_tpu(self):
+        assert MP._interpret() is (jax.default_backend() != "tpu")
+
+    def test_padd_soa_matches_ec(self, batch):
+        a, b = batch
+        got = MP.from_soa(MP.padd_soa(MP.to_soa(a), MP.to_soa(b)))
+        assert np.array_equal(np.asarray(got), np.asarray(ec.padd(a, b)))
+
+    def test_padd_soa_pads_partial_lane_batch(self, batch):
+        # n=8 < LANE exercises the pad-to-128 + slice-back path
+        a, b = batch
+        out = MP.padd_soa(MP.to_soa(a), MP.to_soa(b))
+        assert out.shape == (MP.ROWS, a.shape[0])
 
 
 @pytest.mark.skipif(not os.environ.get("RUN_SLOW"),
