@@ -1,13 +1,13 @@
 # Build orchestration (reference parity: `justfile` recipes).
 
-.PHONY: all native test test-slow test-faults test-farm test-gateway fixtures bench bench-fast bench-multichip bench-serve setup-committee setup-step lint lint-fast tpu-evidence report-ci
+.PHONY: all native test test-slow test-faults test-farm test-gateway fixtures bench bench-fast bench-multichip bench-serve setup-committee setup-step lint lint-fast lint-deep tpu-evidence report-ci
 
 all: native
 
 native:
 	$(MAKE) -C spectre_tpu/native
 
-test: native lint test-faults test-farm test-gateway bench-fast
+test: native lint lint-deep test-faults test-farm test-gateway bench-fast
 	python -m pytest tests/ -q
 
 # fault-injection tier (PR 3, grown in PR 6): deterministic resilience
@@ -105,13 +105,24 @@ report-ci:
 tpu-evidence: native
 	python scripts/tpu_evidence.py
 
-# static analysis: compile check + the soundness auditor / kernel lint
-# (spectre_tpu/analysis). Fails on any non-baselined error finding; accepted
-# findings live in spectre_tpu/analysis/baseline.json (see README).
+# static analysis: compile check + the soundness auditor / kernel lint /
+# trace-lint AST scan (spectre_tpu/analysis). Fails on any non-baselined
+# error finding; accepted findings live in spectre_tpu/analysis/baseline.json
+# (see README). --no-probes: the dynamic retrace probes are the lint-deep
+# tier below, so `make test` (which runs both) compiles them only once.
 lint:
 	python -m compileall -q spectre_tpu tests bench.py __graft_entry__.py
-	JAX_PLATFORMS=cpu python -m spectre_tpu.analysis --fail-on error
+	JAX_PLATFORMS=cpu python -m spectre_tpu.analysis --fail-on error --no-probes
 
 # kernel-lint only (seconds; the full `lint` builds three tiny circuits)
 lint-fast:
 	JAX_PLATFORMS=cpu python -m spectre_tpu.analysis --engine kernel --fail-on error
+
+# deep tier: trace-cache hygiene — static AST scan of jit/shard_map/
+# pallas_call sites vs the declared runner registry (TC-FRESH-JIT,
+# TC-CONST-CAPTURE, TC-UNSTABLE-STATIC, TC-UNCACHED-RUNNER) plus dynamic
+# double-call probes over every runner family asserting zero recompiles on
+# the second call (TC-RETRACE-DYN — the historical rc=124 class). Budgeted
+# under 120s on a 1-core CPU host (tests/test_analysis.py pins it).
+lint-deep:
+	JAX_PLATFORMS=cpu python -m spectre_tpu.analysis --engine trace --fail-on error

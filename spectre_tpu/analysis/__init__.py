@@ -1,30 +1,40 @@
-"""Static analysis for the prover: circuit soundness audit + JAX kernel lint.
+"""Static analysis for the prover: circuit audit + kernel lint + trace lint.
 
-Two engines, one finding stream (motivation: ISSUE 1 — every MXU/limb
+Three engines, one finding stream (motivation: ISSUE 1 — every MXU/limb
 rewrite of the prover's hot path is a chance to drop a constraint or
 overflow a limb with no test that notices; zkSpeed and SZKP both flag this
 as the cost of porting provers to wide SIMD/systolic datapaths):
 
 - `circuit_audit` walks a builder `Context` + synthesized `CircuitConfig`
   and reports under-constrained advice cells, degree-budget violations,
-  unbound lookup tables, copy-constraint orphans, and dead (all-zero)
-  fixed/selector columns.
+  unbound lookup tables, copy-constraint orphans, dead (all-zero)
+  fixed/selector columns, and row-level coverage holes over the physical
+  assignment grid (CA-ROW-UNBOUND / CA-ROW-DEAD-SELECTOR).
 - `kernel_lint` traces the hot device ops to jaxprs and flags integer
   multiplies/adds whose worst-case value exceeds the lane dtype, float
   dtypes leaking into field arithmetic, and host callbacks inside kernels.
+- `trace_lint` guards the trace-cache discipline (the rc=124 retrace bug
+  class): an AST scan of jit/shard_map/pallas_call construction sites in
+  ops/, parallel/, plonk/ cross-checked against the declared runner
+  registry (TC-FRESH-JIT, TC-CONST-CAPTURE, TC-UNSTABLE-STATIC,
+  TC-UNCACHED-RUNNER), plus dynamic double-call probes asserting zero
+  recompiles on the second call of every runner family (TC-RETRACE-DYN).
 
-CLI: `python -m spectre_tpu.analysis --fail-on error`. Accepted findings
-live in the checked-in `baseline.json` next to this file (see README
-"Static analysis" for the suppression workflow).
+CLI: `python -m spectre_tpu.analysis --fail-on error` (`--engine trace` is
+the deep tier behind `make lint-deep`). Accepted findings live in the
+checked-in `baseline.json` next to this file (see README "Static analysis"
+for the suppression workflow).
 """
 
 from .findings import (Finding, Severity, load_baseline, write_baseline,
                        partition_findings, format_finding)
-from .circuit_audit import audit_context, DegreeCtx
+from .circuit_audit import audit_context, audit_rows, DegreeCtx
 from .kernel_lint import lint_kernel, lint_all_kernels, KERNELS
+from .trace_lint import lint_trace, scan_files, run_probes, PROBES
 
 __all__ = [
     "Finding", "Severity", "load_baseline", "write_baseline",
-    "partition_findings", "format_finding", "audit_context", "DegreeCtx",
-    "lint_kernel", "lint_all_kernels", "KERNELS",
+    "partition_findings", "format_finding", "audit_context", "audit_rows",
+    "DegreeCtx", "lint_kernel", "lint_all_kernels", "KERNELS",
+    "lint_trace", "scan_files", "run_probes", "PROBES",
 ]

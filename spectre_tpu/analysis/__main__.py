@@ -1,11 +1,18 @@
 """CLI: `python -m spectre_tpu.analysis [--fail-on error]`.
 
-Runs both engines (circuit soundness audit over the tiny-spec app circuits,
-kernel lint over the hot device ops), subtracts the checked-in
+Runs the analysis engines (circuit soundness audit over the tiny-spec app
+circuits, kernel lint over the hot device ops, trace-cache hygiene lint over
+the jit/shard_map call sites + retrace probes), subtracts the checked-in
 `baseline.json` suppressions, prints the rest, and exits nonzero when any
 unsuppressed finding reaches the --fail-on severity. `--write-baseline`
 accepts the current active findings into the suppression file (review the
 diff — every entry is a consciously accepted soundness exception).
+
+`--engine trace` is the deep tier (`make lint-deep`): the static AST scan is
+sub-second, the dynamic double-call probes compile every registered runner
+family once (~90s on a 1-core CPU host, budgeted under 120s by
+tests/test_analysis.py). `--json PATH` writes a machine-readable report:
+active/suppressed findings, per-pass wall time, and per-engine root counts.
 """
 
 from __future__ import annotations
@@ -19,14 +26,18 @@ import time
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m spectre_tpu.analysis",
-        description="circuit soundness auditor + JAX kernel lint")
-    ap.add_argument("--engine", choices=("all", "circuit", "kernel"),
+        description="circuit soundness auditor + JAX kernel lint "
+                    "+ trace-cache hygiene lint")
+    ap.add_argument("--engine", choices=("all", "circuit", "kernel", "trace"),
                     default="all")
     ap.add_argument("--circuits", default="committee_update,sync_step,"
                     "aggregation",
                     help="comma list of audit circuits, or 'none'")
     ap.add_argument("--kernels", default="",
                     help="comma list of kernel names (default: all)")
+    ap.add_argument("--no-probes", action="store_true",
+                    help="trace engine: static AST scan only, skip the "
+                         "dynamic retrace probes")
     ap.add_argument("--fail-on", choices=("error", "warning", "never"),
                     default="error", dest="fail_on")
     ap.add_argument("--baseline", default=None,
@@ -41,12 +52,24 @@ def main(argv=None) -> int:
                            partition_findings, write_baseline)
 
     findings = []
+    passes = []   # [{name, engine, seconds, findings}] for --json
+    roots = {}    # per-engine root counts for --json
     t0 = time.time()
+
+    def record(name, engine, t, fs):
+        passes.append({"name": name, "engine": engine,
+                       "seconds": round(time.time() - t, 3),
+                       "findings": len(fs)})
+        if not opts.quiet:
+            print(f"[analysis] {name}: {len(fs)} finding(s) "
+                  f"({time.time() - t:.1f}s)", flush=True)
 
     if opts.engine in ("all", "circuit") and opts.circuits != "none":
         from .circuit_audit import audit_context
         from .circuits import AUDIT_CIRCUITS
-        for cname in [c for c in opts.circuits.split(",") if c]:
+        wanted = [c for c in opts.circuits.split(",") if c]
+        roots["circuits"] = len(wanted)
+        for cname in wanted:
             build = AUDIT_CIRCUITS.get(cname)
             if build is None:
                 ap.error(f"unknown circuit {cname!r} "
@@ -55,19 +78,32 @@ def main(argv=None) -> int:
             ctx, cfg, name = build()
             fs = audit_context(ctx, cfg, name)
             findings += fs
-            if not opts.quiet:
-                print(f"[analysis] circuit {name}: {len(fs)} finding(s) "
-                      f"({time.time() - t:.1f}s)", flush=True)
+            record(f"circuit {name}", "circuit", t, fs)
 
     if opts.engine in ("all", "kernel"):
-        from .kernel_lint import lint_all_kernels
+        from .kernel_lint import KERNELS, lint_all_kernels
         t = time.time()
         names = set(k for k in opts.kernels.split(",") if k) or None
+        roots["kernels"] = len(names) if names else len(KERNELS)
         fs = lint_all_kernels(names)
         findings += fs
-        if not opts.quiet:
-            print(f"[analysis] kernel lint: {len(fs)} finding(s) "
-                  f"({time.time() - t:.1f}s)", flush=True)
+        record("kernel lint", "kernel", t, fs)
+
+    if opts.engine in ("all", "trace"):
+        from . import trace_lint
+        roots.update(trace_lint.root_counts())
+        t = time.time()
+        fs = trace_lint.scan_files()
+        findings += fs
+        record("trace static scan", "trace", t, fs)
+        if not opts.no_probes:
+            for spec in trace_lint.PROBES:
+                t = time.time()
+                fs = trace_lint.run_probe(spec)
+                findings += fs
+                record(f"trace probe {spec.name}", "trace", t, fs)
+        else:
+            roots["trace_probes"] = 0
 
     baseline = load_baseline(opts.baseline)
     active, suppressed = partition_findings(findings, baseline)
@@ -87,7 +123,10 @@ def main(argv=None) -> int:
     if opts.json:
         with open(opts.json, "w") as fh:
             json.dump({"active": [f.to_dict() for f in active],
-                       "suppressed": [f.to_dict() for f in suppressed]},
+                       "suppressed": [f.to_dict() for f in suppressed],
+                       "passes": passes,
+                       "roots": roots,
+                       "seconds": round(time.time() - t0, 3)},
                       fh, indent=1)
 
     counts = {}

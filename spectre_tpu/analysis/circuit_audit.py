@@ -24,6 +24,23 @@ Rules (all keyed for baseline suppression, see findings.py):
   CA-DEAD-SELECTOR     warning  all-zero selector column: the gate in that
                                 advice column is never active.
   CA-DEAD-FIXED        warning  all-zero fixed column (dead constants).
+  CA-ROW-UNBOUND       error    PLACED advice cell whose physical row no
+                                gate window (selector rotations 0..+3)
+                                covers and no copy/constant/instance
+                                endpoint binds. The row-wise sharpening of
+                                CA-UNDERCONSTRAINED: that rule reasons over
+                                builder streams, this one over the actual
+                                assignment grid after layout — it catches
+                                placement bugs the stream view cannot see
+                                (a selector landing on the wrong row, a
+                                copy translated to the wrong coordinate).
+  CA-ROW-DEAD-SELECTOR warning  selector fires on a row whose gate window
+                                reads no placed cell — a vacuous gate
+                                activation (satisfied by the zero padding
+                                today, a trap for the next layout change).
+                                With SHA slots configured, also flags
+                                structural SHA selectors armed over slots
+                                the circuit never filled.
 
 The walk is pure host Python over builder streams — no SRS, no keygen, no
 proving; tiny-spec circuits audit in seconds.
@@ -31,8 +48,14 @@ proving; tiny-spec circuits audit in seconds.
 
 from __future__ import annotations
 
-from ..plonk.constraint_system import (SHA_SLOT_ROWS, SHA_WORD_COLS,
-                                       CircuitConfig, table_column)
+import itertools
+
+import numpy as np
+
+from ..plonk.constraint_system import (GATE_ROWS, SHA_SLOT_ROWS,
+                                       SHA_WORD_COLS, CircuitConfig,
+                                       gate_coverage, sha_selector_columns,
+                                       table_column)
 from ..plonk.expressions import all_expressions
 from .findings import Finding, Severity
 
@@ -237,17 +260,135 @@ def _audit_dead_columns(ctx, cfg, name) -> list:
     return out
 
 
+def audit_rows(ctx, cfg, name, mutate=None) -> list:
+    """Row-wise gate-coverage audit over the PHYSICAL assignment grid.
+
+    Joins `ctx.cell_placement(cfg)` (stream index -> (column, row)) against
+    the layout's selector grid and the global-coordinate copy endpoints:
+
+      * a placed cell is ROW-COVERED when some selector window (rotations
+        0..+GATE_ROWS-1, `gate_coverage`) reads its row, and COPY-BOUND
+        when some copy/constant-pin/instance endpoint lands on its exact
+        (column, row). Neither -> CA-ROW-UNBOUND (error).
+      * a selector firing on a row whose whole window holds no placed cell
+        is a vacuous activation -> CA-ROW-DEAD-SELECTOR (warning); SHA
+        structural selectors armed over unfilled slots are the same class.
+
+    `mutate` exists for the mutation tests: it receives copies of
+    (placement, selectors, copies) after layout and may return a modified
+    triple — seeded row-level bugs must surface as CA-ROW-* findings."""
+    try:
+        _adv, _lkp, _fx, selectors, copies, _inst, _bp = ctx.layout(cfg)
+        placement = ctx.cell_placement(cfg)
+    except (AssertionError, KeyError) as e:
+        return [Finding(
+            "circuit", "CA-ROW-UNBOUND", Severity.WARNING, _CTX_FILE, name,
+            f"layout failed ({e}) — row-coverage audit skipped",
+            key=f"CA-ROW-LAYOUT-FAILED:{name}")]
+    if mutate is not None:
+        # copies, so seeded bugs never poison the Context's layout caches
+        res = mutate(dict(placement), [list(c) for c in selectors],
+                     list(copies))
+        if res is not None:
+            placement, selectors, copies = res
+
+    n, ncols = cfg.n, cfg.num_advice
+    cov = gate_coverage(selectors)                      # [ncols, n]
+    bound = np.zeros((ncols, n), np.uint8)
+    if copies:
+        # flat int32 fromiter: sync_step:tiny carries ~14M copies — a
+        # per-endpoint Python loop is minutes, this is seconds
+        ends = np.fromiter(
+            itertools.chain.from_iterable(
+                itertools.chain.from_iterable(copies)),
+            dtype=np.int32, count=4 * len(copies)).reshape(-1, 2)
+        cc, rr = ends[:, 0], ends[:, 1]
+        ok = (cc >= 0) & (cc < ncols) & (rr >= 0) & (rr < n)
+        bound[cc[ok], rr[ok]] = 1
+        del ends, cc, rr, ok
+
+    out = []
+    if placement:
+        cr = np.fromiter(
+            itertools.chain.from_iterable(placement.values()),
+            dtype=np.int32, count=2 * len(placement)).reshape(-1, 2)
+        cols, rows = cr[:, 0], cr[:, 1]
+        free = (cov[cols, rows] == 0) & (bound[cols, rows] == 0)
+        if free.any():
+            idxs = np.fromiter(placement.keys(), dtype=np.int64,
+                               count=len(placement))
+            per_col = np.bincount(cols[free], minlength=ncols)
+            for c in np.nonzero(per_col)[0]:
+                sel = free & (cols == c)
+                where = sorted(zip(rows[sel].tolist(),
+                                   idxs[sel].tolist()))[:6]
+                preview = ", ".join(f"r{r}(cell {i})" for r, i in where)
+                more = (f", ... ({int(per_col[c])} total)"
+                        if per_col[c] > 6 else "")
+                out.append(Finding(
+                    "circuit", "CA-ROW-UNBOUND", Severity.ERROR, _CTX_FILE,
+                    name,
+                    f"advice column {int(c)}: {int(per_col[c])} placed "
+                    f"cell(s) on rows no gate window covers and no copy "
+                    f"binds [{preview}{more}] — free witness rows",
+                    key=f"CA-ROW-UNBOUND:{name}:col{int(c)}:"
+                        f"{int(per_col[c])}"))
+
+        # occupancy -> window-occupancy: sel row r is live iff ANY of rows
+        # r..r+GATE_ROWS-1 holds a placed cell
+        occ = np.zeros((ncols, n), np.uint8)
+        occ[cols, rows] = 1
+    else:
+        occ = np.zeros((ncols, n), np.uint8)
+    wocc = occ.copy()
+    for off in range(1, GATE_ROWS):
+        wocc[:, :n - off] |= occ[:, off:]
+    sel_grid = np.asarray(selectors, np.uint8)
+    dead = (sel_grid == 1) & (wocc == 0)
+    for c in np.nonzero(dead.any(axis=1))[0]:
+        drows = np.nonzero(dead[c])[0]
+        preview = ", ".join(str(int(r)) for r in drows[:6])
+        more = f", ... ({len(drows)} total)" if len(drows) > 6 else ""
+        out.append(Finding(
+            "circuit", "CA-ROW-DEAD-SELECTOR", Severity.WARNING, _CTX_FILE,
+            name,
+            f"selector column {int(c)} fires on {len(drows)} row(s) whose "
+            f"gate window reads no placed cell [rows {preview}{more}] — "
+            f"vacuous gate activation",
+            key=f"CA-ROW-DEAD-SELECTOR:{name}:col{int(c)}:{len(drows)}"))
+
+    if cfg.num_sha_slots:
+        # structural SHA selectors patterned for cfg.num_sha_slots slots;
+        # rows of slots the circuit never filled are vacuously gated
+        sha_sel, _k = sha_selector_columns(cfg)
+        used_rows = len(ctx.sha_slots) * SHA_SLOT_ROWS
+        sha = np.asarray(sha_sel, np.uint8)
+        stale = sha[:, used_rows:]
+        for j in np.nonzero(stale.any(axis=1))[0]:
+            cnt = int(stale[j].sum())
+            out.append(Finding(
+                "circuit", "CA-ROW-DEAD-SELECTOR", Severity.WARNING,
+                _CS_FILE, name,
+                f"sha selector {int(j)} armed on {cnt} row(s) beyond the "
+                f"{len(ctx.sha_slots)} filled slot(s) (cfg allocates "
+                f"{cfg.num_sha_slots}) — vacuous structural gating",
+                key=f"CA-ROW-DEAD-SELECTOR:{name}:sha{int(j)}:{cnt}"))
+    return out
+
+
 def audit_context(ctx, cfg: CircuitConfig, name: str,
-                  expressions_fn=all_expressions) -> list:
+                  expressions_fn=all_expressions, row_mutate=None) -> list:
     """Run every circuit-audit rule; returns findings in severity order.
 
     `expressions_fn` exists for the mutation tests: injecting a constraint
-    generator with a seeded over-degree expression must produce CA-DEGREE."""
+    generator with a seeded over-degree expression must produce CA-DEGREE.
+    `row_mutate` is the row-audit equivalent (see `audit_rows`)."""
     findings = []
     findings += _audit_cell_references(ctx, name)
     findings += _audit_degrees(cfg, name, expressions_fn)
     findings += _audit_tables(ctx, cfg, name)
     findings += _audit_copy_orphans(ctx, cfg, name)
     findings += _audit_dead_columns(ctx, cfg, name)
+    findings += audit_rows(ctx, cfg, name, mutate=row_mutate)
     findings.sort(key=lambda f: -Severity.ORDER[f.severity])
     return findings

@@ -29,11 +29,11 @@ class Severity:
 
 @dataclass(frozen=True)
 class Finding:
-    engine: str      # "circuit" | "kernel"
+    engine: str      # "circuit" | "kernel" | "trace"
     rule: str        # e.g. "CA-UNDERCONSTRAINED", "KL-OVERFLOW"
     severity: str    # Severity.*
     file: str        # repo-relative path of the audited source
-    obj: str         # circuit or kernel name (e.g. "committee_update:tiny")
+    obj: str         # circuit/kernel/probe name (e.g. "committee_update:tiny")
     message: str
     key: str = ""    # stable suppression key; default derived from the rest
 

@@ -376,7 +376,25 @@ class Context:
         for i, av in enumerate(self.instance_cells):
             copies.append((cell_coord(av.stream, av.index),
                            (cfg.col_instance(0), i)))
+        # stash the physical placement for the row-wise coverage audit
+        # (analysis/circuit_audit): rebuilt together with the layout, so the
+        # two caches can never disagree about which cfg they describe
+        self._placement_cache = (cfg, placement)
         return advice, lookup, fixed, selectors, copies, instances, break_points
+
+    def cell_placement(self, cfg: CircuitConfig) -> dict:
+        """Analysis hook (spectre_tpu.analysis.circuit_audit): physical
+        placement of the advice stream, {stream index -> (column, row)}.
+        The row auditor joins this against the layout's selector grid and
+        copy endpoints to find rows no gate window or copy binds."""
+        cached = getattr(self, "_placement_cache", None)
+        if cached is not None and cached[0] == cfg:
+            return cached[1]
+        result = self._layout_uncached(cfg)
+        self._layout_cache = (cfg, result)
+        cached = self._placement_cache
+        assert cached[0] == cfg
+        return cached[1]
 
     def sha_columns(self, cfg: CircuitConfig):
         """Materialize the slot list into full [cols, n] region columns."""

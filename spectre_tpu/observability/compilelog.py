@@ -9,8 +9,11 @@ backend compile) through `jax.monitoring`; `install()` registers one
 process-global listener that fans each event into three sinks:
 
   1. `spectre_compile_seconds{fn=}` (metrics.COMPILE_SECONDS) — fn is
-     the innermost open tracing span (`prove/commit_advice`, ...) so
-     compile cost is attributed to the phase that triggered it.
+     the innermost open `entry_point(...)` (the named jitted entry that
+     actually missed its trace cache — sharded MSM/NTT runners push one),
+     falling back to the innermost open tracing span
+     (`prove/commit_advice`, ...) so compile cost is attributed to the
+     phase that triggered it.
      Only `backend_compile` events are observed (the others are
      sub-steps of the same compilation; counting all three would
      triple-count one cache miss).
@@ -62,9 +65,39 @@ _install_failed: str | None = None
 class _Local(threading.local):
     def __init__(self):
         self.events: list | None = None
+        # innermost-wins stack of named compile entry points (see
+        # `entry_point`): sharded/batched runner entries push their own
+        # name so a compile triggered inside e.g. `prove/commit_advice`
+        # is attributed to the jitted entry that actually missed its
+        # trace cache, not lumped into the parent phase span
+        self.entry_points: list[str] = []
 
 
 _local = _Local()
+
+
+@contextlib.contextmanager
+def entry_point(name: str):
+    """Attribute compile events fired inside this block to `name`.
+
+    Nested entry points win innermost-first (a two-level jit compiles
+    under the inner name); with no entry point open, attribution falls
+    back to the innermost tracing span (the phase) as before."""
+    _local.entry_points.append(name)
+    try:
+        yield
+    finally:
+        _local.entry_points.pop()
+
+
+def current_entry_point() -> str | None:
+    st = _local.entry_points
+    return st[-1] if st else None
+
+
+def _attribution() -> str:
+    return (current_entry_point() or tracing.current_span_name()
+            or UNATTRIBUTED)
 
 
 def _kind(event: str) -> str:
@@ -79,7 +112,7 @@ def _listener(event: str, duration_secs: float, **_kw):
     if not event.startswith(COMPILE_EVENT_PREFIX):
         return
     kind = _kind(event)
-    fn = tracing.current_span_name() or UNATTRIBUTED
+    fn = _attribution()
     # round ONCE and feed the same float to histogram and manifest sink:
     # tests pin exact (not approximate) parity between the two
     secs = round(float(duration_secs), 6)
@@ -101,7 +134,7 @@ def _event_listener(event: str, **_kw):
     sink = _local.events
     if sink is not None:
         sink.append({"event": f"persistent_cache_{tag}",
-                     "fn": tracing.current_span_name() or UNATTRIBUTED,
+                     "fn": _attribution(),
                      "seconds": 0.0})
 
 

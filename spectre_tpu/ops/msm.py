@@ -226,6 +226,14 @@ def _msm_windows_impl(points, scalars, c: int, nbits: int):
     return _aggregate_buckets(bucket_sums, c)
 
 
+# module-level jitted entry points (trace-cache hygiene lint roots):
+# analysis/trace_lint verifies each name below is a stable module-level
+# jit — the discipline that keeps per-prove calls on a warm trace cache.
+TRACE_JIT_ROOTS = ("msm_windows", "msm_windows_bits", "msm_windows_signed",
+                   "combine_windows", "_build_window_table", "msm_fixed_run",
+                   "msm_windows_batch")
+
+
 @functools.partial(jax.jit, static_argnums=(2,))
 def msm_windows(points, scalars, c: int):
     """Per-window partial MSM sums: [nwin, 3, 16].

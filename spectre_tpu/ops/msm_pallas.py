@@ -204,6 +204,13 @@ def _padd_kernel(p_ref, q_ref, o_ref):
     o_ref[:, :] = _k_padd(p_ref[:, :], q_ref[:, :])
 
 
+# module-level jitted entry points (trace-cache hygiene lint roots):
+# analysis/trace_lint verifies each name below is a stable module-level
+# jit; the pallas_call below lives INSIDE a jit-decorated function, so
+# the outer jit caches its trace (exempt from TC-FRESH-JIT by design).
+TRACE_JIT_ROOTS = ("_padd_soa_call", "msm_windows_soa")
+
+
 @functools.partial(jax.jit, static_argnames=("block", "interpret"))
 def _padd_soa_call(p, q, block: int, interpret: bool):
     from jax.experimental import pallas as pl

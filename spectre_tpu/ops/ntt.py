@@ -517,6 +517,12 @@ def _batch_rows(a, body):
     return body(a)
 
 
+# module-level jitted entry points (trace-cache hygiene lint roots):
+# analysis/trace_lint verifies each name below is a stable module-level
+# jit; every public ntt/intt/coset wrapper funnels through these two.
+TRACE_JIT_ROOTS = ("_fwd_kernel", "_inv_kernel")
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
 def _fwd_kernel(a, omega: int, in_kind, mode: str, kernel: str = "stages"):
     """in_kind: None (mont input, no scale), ("mont", g) fused coset

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from ._compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability import compilelog
 from ..ops import msm as MSM
 
 
@@ -36,6 +37,14 @@ def _batch_mesh(ndev: int | None = None) -> Mesh:
 # full SRS to all devices and re-wraps jit (losing its trace cache)
 _repl_cache: dict = {}      # (id(points), n, mesh key) -> (strong ref, dev arr)
 _runner_cache: dict = {}    # (mesh key, c) -> jitted shard_map program
+
+# runner registry (trace-cache hygiene contract, parallel/plan.py):
+# declared builders are cross-checked by analysis/trace_lint
+# (TC-UNCACHED-RUNNER) and exercised by its retrace probes.
+TRACE_RUNNER_CACHES = (
+    ("_runner", "_runner_cache"),
+    ("_runner_glv", "_runner_cache"),
+)
 
 
 def _mesh_key(mesh: Mesh) -> tuple:
@@ -144,10 +153,12 @@ def batch_msm_dp(points, scalars_batch, c: int | None = None,
     sb = jax.device_put(jnp.asarray(scalars_batch),
                         NamedSharding(mesh, P("batch", None, None)))
     pts = _replicated_base(points, mesh)
-    if neg_batch is None:
-        out = _runner(mesh, c)(pts, sb)
-    else:
-        ngb = jax.device_put(jnp.asarray(neg_batch),
-                             NamedSharding(mesh, P("batch", None)))
-        out = _runner_glv(mesh, c, nbits, signed)(pts, sb, ngb)
+    # per-entry-point compile attribution (innermost entry wins)
+    with compilelog.entry_point("parallel.batch_msm"):
+        if neg_batch is None:
+            out = _runner(mesh, c)(pts, sb)
+        else:
+            ngb = jax.device_put(jnp.asarray(neg_batch),
+                                 NamedSharding(mesh, P("batch", None)))
+            out = _runner_glv(mesh, c, nbits, signed)(pts, sb, ngb)
     return out[:b]

@@ -213,3 +213,38 @@ def current_plan() -> ShardingPlan:
     """The process-default plan: `default_mesh()` (all local devices,
     honoring SPECTRE_MESH_SHAPE) interned through `plan_for_mesh`."""
     return plan_for_mesh(default_mesh())
+
+
+# ---------------------------------------------------------------------------
+# runner-registry contract (trace-cache hygiene)
+# ---------------------------------------------------------------------------
+# Every module that builds jitted/SPMD programs against a plan keys its
+# compiled-program cache on `plan.key` + its own statics, and DECLARES the
+# (builder, cache-dict) pairs in a module-level `TRACE_RUNNER_CACHES`
+# tuple (modules whose jitted entry points live at module level declare a
+# `TRACE_JIT_ROOTS` name tuple instead). The declarations are read by
+# `spectre_tpu.analysis.trace_lint` via AST — no imports, so ops/ modules
+# never grow an import edge into parallel/ — which flags undeclared or
+# stale entries (TC-UNCACHED-RUNNER) and dynamically double-calls the
+# registered runners asserting zero recompiles (TC-RETRACE-DYN).
+
+# modules participating in the runner-registry contract
+RUNNER_REGISTRY_MODULES = (
+    "spectre_tpu.parallel.sharded_msm",
+    "spectre_tpu.parallel.sharded_ntt",
+    "spectre_tpu.parallel.batch_msm",
+    "spectre_tpu.plonk.quotient_device",
+    "spectre_tpu.plonk.backend",
+)
+
+
+def runner_registry() -> dict:
+    """{module name -> declared (builder, cache) pairs} — the live-import
+    view of the contract (tests pin it against the AST view)."""
+    import importlib
+
+    out = {}
+    for name in RUNNER_REGISTRY_MODULES:
+        m = importlib.import_module(name)
+        out[name] = tuple(getattr(m, "TRACE_RUNNER_CACHES", ()))
+    return out

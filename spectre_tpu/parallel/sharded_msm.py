@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from ._compat import shard_map
 
+from ..observability import compilelog
 from ..ops import ec, msm as MSM
 from .plan import ShardingPlan, plan_for_mesh
 
@@ -54,6 +55,16 @@ def _fold_points(stacked):
 # plus every static parameter the closure bakes in; values are stable
 # jitted function objects so jax's trace cache actually hits.
 _RUNNERS: dict = {}
+
+# runner registry (the trace-cache hygiene contract, parallel/plan.py):
+# every builder that stores a jitted program in a module cache is declared
+# here; analysis/trace_lint cross-checks the pairs against the AST
+# (TC-UNCACHED-RUNNER) and its retrace probes exercise the runners.
+TRACE_RUNNER_CACHES = (
+    ("_windows_runner", "_RUNNERS"),
+    ("_table_build_runner", "_RUNNERS"),
+    ("_fixed_runner", "_RUNNERS"),
+)
 
 
 def _nwin_for(c: int, nbits: int, signed: bool) -> int:
@@ -211,8 +222,11 @@ def sharded_msm(points, scalars, c: int, mesh: Mesh, nbits: int = 254,
     if signed:
         args.append(neg if neg is not None
                     else jnp.zeros(points.shape[0], dtype=bool))
-    window_sums = _windows_runner(plan, c, nbits, signed)(*args)[:nwin]
-    return MSM.combine_windows(window_sums, c)
+    # any compile fired here is attributed to THIS runner (not lumped
+    # into the parent prove phase) — per-entry-point compile telemetry
+    with compilelog.entry_point("parallel.sharded_msm"):
+        window_sums = _windows_runner(plan, c, nbits, signed)(*args)[:nwin]
+        return MSM.combine_windows(window_sums, c)
 
 
 def shard_points(points, scalars, mesh: Mesh,
@@ -311,7 +325,8 @@ def sharded_fixed_table(points, c: int, nwin: int, plan: ShardingPlan,
     hit = _SHARD_TABLES.get(key)
     if hit is not None:
         return hit[1]
-    tab = _table_build_runner(plan, c, nwin, nwin_padded)(points)
+    with compilelog.entry_point("parallel.sharded_fixed_table"):
+        tab = _table_build_runner(plan, c, nwin, nwin_padded)(points)
     if len(_SHARD_TABLES) > 4:
         _SHARD_TABLES.clear()
     _SHARD_TABLES[key] = (ref, tab)
@@ -362,4 +377,5 @@ def sharded_msm_fixed(table, scalars, neg, c: int, plan: ShardingPlan,
     """Fixed-base MSM against a mesh-resident sharded table. scalars
     [N, 8] GLV half-scalar magnitudes placed per plan.scalar_spec, neg [N]
     signs per plan.sign_spec. Returns a replicated [3, 16] result."""
-    return _fixed_runner(plan, c, nbits)(table, scalars, neg)
+    with compilelog.entry_point("parallel.sharded_msm_fixed"):
+        return _fixed_runner(plan, c, nbits)(table, scalars, neg)

@@ -33,6 +33,7 @@ from ._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..fields import bn254
+from ..observability import compilelog
 from ..ops import field_ops as F, ntt as NTT
 from .plan import ShardingPlan, plan_for_mesh
 
@@ -50,6 +51,11 @@ _twiddle_matrix = NTT._twiddle_matrix
 # module docstring.
 _RUNNERS: dict = {}
 _TWIDDLES: dict = {}
+
+# runner registry (trace-cache hygiene contract, parallel/plan.py):
+# declared builders are cross-checked by analysis/trace_lint
+# (TC-UNCACHED-RUNNER) and exercised by its retrace probes.
+TRACE_RUNNER_CACHES = (("_ntt_runner", "_RUNNERS"),)
 
 
 # --- per-shard local compute (no collectives) -------------------------------
@@ -149,6 +155,9 @@ def sharded_ntt(a: jax.Array, omega: int, mesh: Mesh,
     # A[jr, jc] = x[jc*rr + jr]
     A = a.reshape(cc, rr, 16).transpose(1, 0, 2)
     Ad = jax.device_put(A, plan.sharding(P(axis, None, None)))
-    out = run(Ad, twd)                                   # [cc, rr, 16]
+    # compile attribution: a miss here is THIS runner's retrace, not the
+    # parent prove phase's (per-entry-point compile telemetry)
+    with compilelog.entry_point("parallel.sharded_ntt"):
+        out = run(Ad, twd)                               # [cc, rr, 16]
     # out[kc, kr] = X[kr*cc + kc]
     return out.transpose(1, 0, 2).reshape(n, 16)

@@ -575,6 +575,14 @@ class TpuBackend(CpuBackend):
 # identity — which taxed every NTT/MSM boundary crossing in the prove
 _mont_jits: dict = {}
 
+# runner registry (trace-cache hygiene contract, parallel/plan.py):
+# analysis/trace_lint cross-checks these (builder, cache) pairs against
+# the AST (TC-UNCACHED-RUNNER).
+TRACE_RUNNER_CACHES = (
+    ("_mont_fns", "_mont_jits"),
+    ("_encode_points", "_mont_jits"),
+)
+
 
 def _mont_fns():
     # key-presence check, NOT dict truthiness — _encode_points shares this

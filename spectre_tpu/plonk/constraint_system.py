@@ -26,6 +26,7 @@ from .domain import DELTA
 R = bn254.R
 
 ZK_ROWS = 5
+GATE_ROWS = 4   # the vertical gate reads rotations 0..+3 of its column
 PERM_CHUNK = 2  # columns per permutation grand-product (degree 4 budget)
 # Quotient commitment chunks: the prover commits h as NUM_H_CHUNKS size-n
 # pieces, so deg h <= NUM_H_CHUNKS*n - 4 and every constraint expression
@@ -86,6 +87,18 @@ def sha_selector_columns(cfg: "CircuitConfig") -> tuple[list, list]:
         for t in range(64):
             kcol[base + 4 + t] = int(SHA_K[t])
     return sel, kcol
+
+
+def gate_coverage(selectors) -> np.ndarray:
+    """[num_advice, n] uint8 mask of rows read by some active gate window:
+    a selector firing at row r binds rows r..r+GATE_ROWS-1 of its column
+    (the vertical gate's rotations 0..+3). Row-coverage primitive for the
+    analysis auditor's CA-ROW-* rules."""
+    sel = np.asarray(selectors, dtype=np.uint8)
+    cov = sel.copy()
+    for off in range(1, GATE_ROWS):
+        cov[:, off:] |= sel[:, :sel.shape[1] - off]
+    return cov
 
 
 @dataclass(frozen=True)

@@ -47,6 +47,11 @@ R = bn254.R
 _jit_helpers: dict = {}
 _static_cache: dict = {}
 
+# runner registry (trace-cache hygiene contract, parallel/plan.py):
+# analysis/trace_lint cross-checks these (builder, cache) pairs against
+# the AST (TC-UNCACHED-RUNNER).
+TRACE_RUNNER_CACHES = (("_helpers", "_jit_helpers"),)
+
 
 def _fused_vinv() -> bool:
     """SPECTRE_QUOTIENT_FUSED_VINV=0 keeps the explicit [4n, 16] vanishing-

@@ -1,18 +1,22 @@
 """Static-analysis subsystem (spectre_tpu.analysis): finding/baseline
-mechanics, circuit-audit rules, kernel-lint rules — including the seeded
-MUTATION checks: a deliberately under-constrained cell, an over-degree
-expression, and a limb-overflow multiply must each be flagged (the
-auditor's reason to exist is that nothing else notices these)."""
+mechanics, circuit-audit rules, kernel-lint rules, trace-cache hygiene
+rules — including the seeded MUTATION checks: a deliberately
+under-constrained cell, an over-degree expression, a limb-overflow
+multiply, a fresh-per-call jit, and a row-level coverage hole must each
+be flagged (the auditor's reason to exist is that nothing else notices
+these), while the clean live tree produces ZERO findings."""
 
+import dataclasses
 import json
 import random
+import time
 
 import numpy as np
 import pytest
 
 from spectre_tpu.analysis import (Finding, Severity, audit_context,
-                                  load_baseline, partition_findings,
-                                  write_baseline)
+                                  audit_rows, load_baseline,
+                                  partition_findings, write_baseline)
 from spectre_tpu.analysis.circuit_audit import expression_degrees
 from spectre_tpu.analysis.kernel_lint import (KERNELS, lint_fn, lint_kernel,
                                               lint_limbs_host)
@@ -121,6 +125,72 @@ class TestCircuitAudit:
         assert "CA-DEAD-SELECTOR" in rules and "CA-DEAD-FIXED" in rules
 
 
+class TestRowAudit:
+    """Row-wise gate-coverage auditor (ISSUE 16 tentpole): coverage holes
+    in the PHYSICAL assignment grid that the stream-level rules miss."""
+
+    def test_clean_circuit_rows_clean(self):
+        ctx, cfg = _small_circuit()
+        assert audit_rows(ctx, cfg, "clean") == []
+
+    def test_flags_seeded_row_unbound(self):
+        """THE row-level mutation: a placed cell drifts to a row no gate
+        window covers and no copy endpoint binds — a free witness row."""
+        ctx, cfg = _small_circuit()
+
+        def mutate(placement, selectors, copies):
+            placement[max(placement)] = (0, cfg.usable_rows - 2)
+            return placement, selectors, copies
+
+        fs = audit_rows(ctx, cfg, "rowmut", mutate=mutate)
+        assert any(f.rule == "CA-ROW-UNBOUND"
+                   and f.severity == Severity.ERROR for f in fs)
+
+    def test_flags_seeded_dead_selector_row(self):
+        """A selector armed over rows its gate window never reads from."""
+        ctx, cfg = _small_circuit()
+
+        def mutate(placement, selectors, copies):
+            selectors[0][cfg.usable_rows - 8] = 1
+            return placement, selectors, copies
+
+        fs = audit_rows(ctx, cfg, "deadsel", mutate=mutate)
+        assert any(f.rule == "CA-ROW-DEAD-SELECTOR" for f in fs)
+
+    def test_flags_stale_sha_slot_selectors(self):
+        """Config allocates a SHA slot the circuit never fills: the
+        structural selectors gate all-zero rows — vacuous activation."""
+        ctx, cfg = _small_circuit()
+        cfg2 = dataclasses.replace(cfg, num_sha_slots=1)
+        fs = audit_rows(ctx, cfg2, "shastale")
+        assert any(f.rule == "CA-ROW-DEAD-SELECTOR" and ":sha" in f.key
+                   for f in fs)
+
+    def test_row_mutate_does_not_poison_caches(self):
+        """The mutate hook gets copies: a seeded mutant must not leak
+        into the Context's layout/placement caches."""
+        ctx, cfg = _small_circuit()
+
+        def mutate(placement, selectors, copies):
+            placement[max(placement)] = (0, cfg.usable_rows - 2)
+            selectors[0][0] = 0
+            return placement, selectors, copies
+
+        assert audit_rows(ctx, cfg, "m", mutate=mutate) != []
+        assert audit_rows(ctx, cfg, "clean-again") == []
+
+    def test_audit_context_threads_row_mutate(self):
+        ctx, cfg = _small_circuit()
+
+        def mutate(placement, selectors, copies):
+            placement[max(placement)] = (0, cfg.usable_rows - 2)
+            return placement, selectors, copies
+
+        rules = [f.rule for f in audit_context(ctx, cfg, "threaded",
+                                               row_mutate=mutate)]
+        assert "CA-ROW-UNBOUND" in rules
+
+
 class TestKernelLint:
     def test_flags_seeded_limb_overflow_multiply(self):
         """THE mutation check: 17-bit limbs leave no headroom in u32."""
@@ -183,6 +253,232 @@ class TestKernelLint:
         assert lint_limbs_host() == []
 
 
+# --------------------------------------------------------------------------
+# trace-cache hygiene lint (ISSUE 16 tentpole)
+# --------------------------------------------------------------------------
+
+# regression fixture: the pre-ISSUE-13 sharded_msm shape — a fresh
+# shard_map closure wrapped in a fresh jit on EVERY call (the MULTICHIP
+# rc=124 root cause)
+_FRESH_SHARD_SRC = '''\
+import functools
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def sharded_msm(points, scalars, c, mesh):
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P("data"), P("data")), out_specs=P())
+    def run(p, s):
+        return (p * s).sum()
+
+    return jax.jit(run)(points, scalars)
+'''
+
+_EXEMPT_SRC = '''\
+import functools
+
+import jax
+
+_RUNNERS = {}
+
+TRACE_RUNNER_CACHES = (("_get_runner", "_RUNNERS"),)
+
+
+def _get_runner(c):
+    fn = _RUNNERS.get(c)
+    if fn is None:
+        fn = jax.jit(lambda x: x * c)
+        _RUNNERS[c] = fn
+    return fn
+
+
+@functools.cache
+def _memo_runner(c):
+    return jax.jit(lambda x: x + c)
+
+
+@jax.jit
+def entry(x):
+    return jax.jit(lambda v: v)(x)
+'''
+
+_CONSTCAP_SRC = '''\
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LUT = jnp.arange(8)
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] + _LUT
+
+
+@jax.jit
+def call(x):
+    return pl.pallas_call(_kernel, out_shape=x)(x)
+'''
+
+_UNSTABLE_SRC = '''\
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,), static_argnames=("mode",))
+def kernel(x, shape, mode="std"):
+    return x
+
+
+def caller(x):
+    return kernel(x, [4, 4], mode="std")
+
+
+def caller2(x):
+    return kernel(x, (4, 4), mode={"a": 1})
+'''
+
+_UNDECLARED_SRC = '''\
+import jax
+
+_RUNNERS = {}
+
+
+def _build(key):
+    fn = jax.jit(lambda x: x)
+    _RUNNERS[key] = fn
+    return fn
+'''
+
+_STALE_SRC = '''\
+import jax
+
+_RUNNERS = {}
+
+TRACE_RUNNER_CACHES = (("_vanished", "_RUNNERS"),)
+TRACE_JIT_ROOTS = ("also_gone",)
+'''
+
+
+def _scan_src(tmp_path, src, name="fixture_mod.py"):
+    from spectre_tpu.analysis.trace_lint import scan_files
+    p = tmp_path / name
+    p.write_text(src)
+    return scan_files([str(p)])
+
+
+class TestTraceLintStatic:
+    def test_live_tree_static_scan_clean(self):
+        """The whole ops/ + parallel/ + plonk/ tree honors the trace-cache
+        discipline: zero findings, no baseline entries needed."""
+        from spectre_tpu.analysis.trace_lint import scan_files
+        assert scan_files() == []
+
+    def test_fresh_jit_regression_fixture(self, tmp_path):
+        """ISSUE 16 satellite: the PR 13 fresh-closure shard_map pattern,
+        re-created in a throwaway module, trips TC-FRESH-JIT and NOTHING
+        else."""
+        fs = _scan_src(tmp_path, _FRESH_SHARD_SRC)
+        assert fs and {f.rule for f in fs} == {"TC-FRESH-JIT"}
+        assert {f.severity for f in fs} == {Severity.ERROR}
+        assert all("sharded_msm" in f.key for f in fs)
+        # both constructions inside the body are flagged: the shard_map
+        # decorator closure AND the per-call jit wrap
+        assert {k for _, _, k in
+                (f.key.rsplit(":", 2) for f in fs)} == {"jit", "shard_map"}
+
+    def test_fresh_jit_exemptions(self, tmp_path):
+        """Runner-cache stores, functools.cache builders, and jit-inside-
+        jit (outer jit caches the trace) are NOT fresh-jit findings."""
+        assert _scan_src(tmp_path, _EXEMPT_SRC) == []
+
+    def test_flags_pallas_const_capture(self, tmp_path):
+        """THE mutation check for the PR 15 class: a kernel body reading a
+        module-level concrete-array binding."""
+        fs = _scan_src(tmp_path, _CONSTCAP_SRC)
+        assert {f.rule for f in fs} == {"TC-CONST-CAPTURE"}
+        assert "_LUT" in fs[0].key
+
+    def test_flags_unstable_static_args(self, tmp_path):
+        fs = _scan_src(tmp_path, _UNSTABLE_SRC)
+        assert {f.rule for f in fs} == {"TC-UNSTABLE-STATIC"}
+        assert len(fs) == 2  # list at static position, dict static kwarg
+
+    def test_flags_undeclared_runner_cache(self, tmp_path):
+        fs = _scan_src(tmp_path, _UNDECLARED_SRC)
+        assert {f.rule for f in fs} == {"TC-UNCACHED-RUNNER"}
+        assert fs[0].key.endswith("_build:_RUNNERS")
+
+    def test_flags_stale_registry_entries(self, tmp_path):
+        fs = _scan_src(tmp_path, _STALE_SRC)
+        assert {f.rule for f in fs} == {"TC-UNCACHED-RUNNER"}
+        keys = sorted(f.key for f in fs)
+        assert any(k.endswith(":stale") for k in keys)
+        assert any(k.endswith(":root") for k in keys)
+
+    def test_registry_ast_matches_live_imports(self):
+        """The AST view of TRACE_RUNNER_CACHES (what the lint scans) and
+        the live-import view (plan.runner_registry) agree module by
+        module — declarative drift in either direction is a failure."""
+        import ast
+        import importlib
+
+        from spectre_tpu.analysis.trace_lint import _module_toplevel
+        from spectre_tpu.parallel.plan import runner_registry
+        live = runner_registry()
+        assert live  # contract has participants
+        for modname, declared in live.items():
+            path = importlib.import_module(modname).__file__
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+            _n, _a, ast_pairs, _r = _module_toplevel(tree)
+            assert set(declared) == ast_pairs, modname
+            assert declared, f"{modname} declares no runner caches"
+
+
+class TestTraceLintDynamic:
+    def test_retrace_probe_flags_fresh_jit(self):
+        """THE dynamic mutation check: a runner that mints a fresh jit per
+        call compiles on the second call -> TC-RETRACE-DYN."""
+        import jax
+        import jax.numpy as jnp
+
+        from spectre_tpu.analysis.trace_lint import ProbeSpec, run_probe
+
+        def build():
+            x = jnp.zeros((4,), jnp.uint32)
+
+            def run(v):
+                return jax.jit(lambda t: t + jnp.uint32(1))(v)
+
+            return run, (x,)
+
+        fs = run_probe(ProbeSpec("mutant.fresh", "x.py", build))
+        assert [f.rule for f in fs] == ["TC-RETRACE-DYN"]
+        assert fs[0].key == "TC-RETRACE-DYN:mutant.fresh"
+        assert fs[0].severity == Severity.ERROR
+
+    @pytest.mark.slow
+    def test_probes_clean_and_within_budget(self):
+        """ISSUE 16 satellite: the full probe suite (every registered
+        runner family, double-called at tiny shapes) is clean on the live
+        tree AND fits the 120s lint-deep budget on a 1-core CPU host.
+
+        slow-marked: ~90s of probe compiles on the 1-core box — runs in
+        `make test` (no marker filter; lint-deep also drives the same
+        probes there), stays out of the 870s tier-1 window."""
+        from spectre_tpu.analysis.trace_lint import PROBES, run_probes
+        assert len(PROBES) == 6
+        t0 = time.monotonic()
+        fs = run_probes()
+        dt = time.monotonic() - t0
+        assert fs == [], [f.key for f in fs]
+        assert dt < 120, f"probe suite took {dt:.1f}s (budget 120s)"
+
+
 class TestCLI:
     def test_kernel_engine_exit_clean(self, tmp_path, capsys):
         from spectre_tpu.analysis.__main__ import main
@@ -192,6 +488,43 @@ class TestCLI:
         assert rc == 0
         data = json.load(open(out))
         assert data["active"] == []
+
+    def test_trace_engine_json_payload(self, tmp_path):
+        """ISSUE 16 satellite: --json is machine-readable — findings plus
+        per-pass runtimes plus per-engine root counts."""
+        from spectre_tpu.analysis.__main__ import main
+        out = str(tmp_path / "trace.json")
+        rc = main(["--engine", "trace", "--no-probes", "--json", out, "-q"])
+        assert rc == 0
+        data = json.load(open(out))
+        assert data["active"] == [] and data["suppressed"] == []
+        names = [p["name"] for p in data["passes"]]
+        assert names == ["trace static scan"]
+        p = data["passes"][0]
+        assert p["engine"] == "trace" and p["findings"] == 0
+        assert isinstance(p["seconds"], float)
+        assert data["roots"]["trace_files"] > 10
+        assert data["roots"]["trace_probes"] == 0  # --no-probes
+        assert data["seconds"] >= p["seconds"]
+
+    def test_trace_engine_fail_on_gates_exit(self, tmp_path, monkeypatch):
+        """A seeded trace finding flips the trace-engine exit code."""
+        from spectre_tpu.analysis import __main__ as M
+        from spectre_tpu.analysis import trace_lint as TL
+
+        def fake_scan(paths=None):
+            return [Finding("trace", "TC-FRESH-JIT", Severity.ERROR,
+                            "x.py", "m:f", "seeded",
+                            key="TC-FRESH-JIT:x.py:f:jit")]
+
+        monkeypatch.setattr(TL, "scan_files", fake_scan)
+        monkeypatch.setattr(TL, "PROBES", [])
+        empty = str(tmp_path / "empty.json")
+        assert M.main(["--engine", "trace", "--baseline", empty, "-q"]) == 1
+        bl = str(tmp_path / "bl.json")
+        assert M.main(["--engine", "trace", "--baseline", bl,
+                       "--write-baseline", "-q"]) == 0
+        assert M.main(["--engine", "trace", "--baseline", bl, "-q"]) == 0
 
     def test_fail_on_gates_exit_code(self, tmp_path, monkeypatch):
         """A seeded finding must flip the exit code unless baselined."""
@@ -228,3 +561,14 @@ class TestShippedBaseline:
         with open(path) as fh:
             data = json.load(fh)
         assert data == {"suppressions": []}
+
+    def test_new_passes_need_no_baseline(self):
+        """ISSUE 16 satellite: the trace scan and the row auditor landed
+        against the EMPTY shipped baseline — the live tree is clean under
+        both new passes without a single suppression."""
+        from spectre_tpu.analysis.circuit_audit import audit_rows as AR
+        from spectre_tpu.analysis.circuits import AUDIT_CIRCUITS
+        from spectre_tpu.analysis.trace_lint import scan_files
+        assert scan_files() == []
+        ctx, cfg, name = AUDIT_CIRCUITS["committee_update"]()
+        assert AR(ctx, cfg, name) == []

@@ -559,6 +559,58 @@ class TestServiceObservabilityHTTP:
             server.shutdown()
 
 
+class TestCompileAttribution:
+    """ISSUE 16 satellite: compile telemetry attributes each cache miss to
+    the INNERMOST open `compilelog.entry_point`, not the parent phase —
+    a two-level entry (sharded runner inside a prove phase) books its
+    compile under its own name, and the span fallback still holds when no
+    entry point is open."""
+
+    def test_two_level_entry_points_per_function_counts(self):
+        import jax
+        import jax.numpy as jnp
+
+        from spectre_tpu.observability import compilelog
+
+        assert compilelog.install()
+        # fresh lambdas => guaranteed trace-cache misses for each level
+        outer_fn = jax.jit(lambda v: v + jnp.uint32(1))
+        inner_fn = jax.jit(lambda v: v * jnp.uint32(3))
+        x = jnp.arange(8, dtype=jnp.uint32)
+        with tracing.trace("attr-two-level"), tracing.span("prove/phase"):
+            with compilelog.capture() as events:
+                with compilelog.entry_point("runner.outer"):
+                    outer_fn(x).block_until_ready()
+                    with compilelog.entry_point("runner.inner"):
+                        inner_fn(x).block_until_ready()
+                    # warm second calls: zero new events at either level
+                    outer_fn(x).block_until_ready()
+                    with compilelog.entry_point("runner.inner"):
+                        inner_fn(x).block_until_ready()
+        s = compilelog.summarize(events)
+        assert s["by_fn"]["runner.outer"]["count"] == 1
+        assert s["by_fn"]["runner.inner"]["count"] == 1
+        # nothing leaked into the parent phase span's bucket
+        assert "prove/phase" not in s["by_fn"]
+        assert s["count"] == 2
+
+    def test_span_fallback_without_entry_point(self):
+        import jax
+        import jax.numpy as jnp
+
+        from spectre_tpu.observability import compilelog
+
+        assert compilelog.install()
+        fn = jax.jit(lambda v: v - jnp.uint32(7))
+        x = jnp.arange(8, dtype=jnp.uint32)
+        with tracing.trace("attr-fallback"), tracing.span("prove/fallback"):
+            with compilelog.capture() as events:
+                fn(x).block_until_ready()
+        s = compilelog.summarize(events)
+        assert list(s["by_fn"]) == ["prove/fallback"]
+        assert s["by_fn"]["prove/fallback"]["count"] == 1
+
+
 class TestIntegrityCounters:
     """ISSUE 9 pin: every output-integrity counter rides the existing
     ServiceHealth -> /healthz -> /metrics bridge — each appears in the
