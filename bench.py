@@ -44,7 +44,9 @@ against the checked-in floor in bench_floor.json (fails on >20% regression).
 `python bench.py --sweep-window` times the MSM at each window width c and
 emits one points/s JSON line per width (see bench_sweep_window) — the
 measurement behind the default_window tables; SPECTRE_MSM_WINDOW pins a
-winner. The NTT child additionally reports `ntt_kernel` and a byte-checked
+winner. Every MSM JSON line records the resolved `msm_impl`
+(SPECTRE_MSM_IMPL), and `--impl xla|pallas` pins it for the invocation —
+the pallas-vs-xla per-width sweep is `--sweep-window --impl pallas`. The NTT child additionally reports `ntt_kernel` and a byte-checked
 stages-vs-matmul `kernel_compare` sample (SPECTRE_NTT_KERNEL).
 
 Multichip tier (ISSUE 13): BENCH_METRIC=multichip (= `make bench-multichip`)
@@ -148,13 +150,14 @@ def device_phase(out_path: str):
     _soa_cache = []
 
     def run_soa():
-        # Pallas fused-kernel SoA path (vanilla algorithm only); layout
-        # conversion cached outside the timed iterations
-        c_soa = c or (13 if logn >= 18 else 10)
+        # direct bucket-kernel SoA path (vanilla recode, no mode dispatch);
+        # layout conversion cached outside the timed iterations
+        c_soa = c or (11 if logn >= 18 else 10)
         if not _soa_cache:
             _soa_cache.append(MP.to_soa(pts))
         return np.asarray(MP.combine_windows_soa(
-            MP.msm_windows_soa(_soa_cache[0], sc16, c_soa), c_soa))
+            MP.msm_bucket_windows(_soa_cache[0], sc16, None, c_soa, 254),
+            c_soa))
 
     expect = os.environ.get("BENCH_EXPECT")
 
@@ -164,10 +167,12 @@ def device_phase(out_path: str):
         ex, ey = (int(v, 16) for v in expect.split(","))
         return ec.decode_points(jnp.asarray(res)[None])[0] == (ex, ey)
 
-    # impl order: the pallas kernel path first on real devices, with the
-    # plain-XLA path as in-child fallback (Mosaic availability varies by
-    # backend); BENCH_IMPL=aos|soa pins one. The SoA kernel implements the
-    # vanilla algorithm only, so non-vanilla modes pin the AoS path.
+    # impl order: the raw SoA kernel path first on real devices, with the
+    # mode-dispatched AoS path (which itself honors SPECTRE_MSM_IMPL —
+    # xla or the pallas bucket pipeline, every mode) as in-child fallback
+    # (Mosaic availability varies by backend); BENCH_IMPL=aos|soa pins
+    # one. run_soa times the vanilla recode only, so non-vanilla modes
+    # pin the AoS dispatch path.
     impl_env = os.environ.get("BENCH_IMPL", "auto")
     if impl_env == "soa":
         impls = [("soa", run_soa)]
@@ -221,6 +226,7 @@ def device_phase(out_path: str):
             json.dump({"points_per_s": n / dt, "impl": impl_name,
                        "msm_mode": mode if impl_name.startswith("aos")
                        else "vanilla",
+                       "msm_impl": MSM.msm_impl(),
                        "phase_seconds": tracing.phase_seconds(tr),
                        "compile_seconds": comp["seconds"],
                        "compile_count": comp["count"],
@@ -412,6 +418,7 @@ def multichip_device_phase(out_path: str):
 
     from spectre_tpu.native import host
     from spectre_tpu.observability import compilelog, tracing
+    from spectre_tpu.ops import msm as MSM
     from spectre_tpu.parallel.plan import current_plan
     from spectre_tpu.utils.profiling import phase
     compilelog.install()
@@ -510,6 +517,7 @@ def multichip_device_phase(out_path: str):
                    "n_devices": ndev,
                    "plan": plan.describe(),
                    "msm_mode": bench_msm_mode(),
+                   "msm_impl": MSM.msm_impl(),
                    "ntt_mode": bench_ntt_mode(),
                    "phase_seconds": tracing.phase_seconds(tr),
                    "compile_seconds": comp["seconds"],
@@ -664,6 +672,7 @@ def bench_multichip(fast: bool) -> bool:
         "plan": result["plan"],
         "backend": result.get("backend"),
         "msm_mode": result.get("msm_mode"),
+        "msm_impl": result.get("msm_impl"),
         "ntt_mode": result.get("ntt_mode"),
         "budget_s": budget,
     }
@@ -751,6 +760,17 @@ def main():
         return
 
     fast = "--fast" in sys.argv[1:]
+    # --impl xla|pallas pins SPECTRE_MSM_IMPL for every metric this
+    # invocation times (pallas-vs-xla window sweeps ride this); the
+    # resolved impl is recorded in every MSM JSON line either way
+    argv = sys.argv[1:]
+    if "--impl" in argv:
+        idx = argv.index("--impl")
+        if idx + 1 >= len(argv):
+            print("FAIL: --impl needs a value (xla|pallas)",
+                  file=sys.stderr)
+            sys.exit(2)
+        os.environ["SPECTRE_MSM_IMPL"] = argv[idx + 1]
     # bench floors gate PROVE/kernel throughput, not the verify-before-
     # serve overhead (ISSUE 9) — off unless the operator pins it on; the
     # resolved value is recorded in every metric line
@@ -839,12 +859,13 @@ def bench_sweep_window() -> bool:
         results[c] = round(n / dt)
         print(json.dumps({"metric": f"bn254_msm_2^{logn} window sweep",
                           "c": c, "value": results[c], "unit": "points/s",
-                          "msm_mode": mode,
+                          "msm_mode": mode, "msm_impl": MSM.msm_impl(),
                           "backend": jax.default_backend()}))
     best = max(results, key=results.get)
     print(json.dumps({"metric": f"bn254_msm_2^{logn} window sweep best",
                       "best_c": best, "value": results[best],
                       "unit": "points/s", "msm_mode": mode,
+                      "msm_impl": MSM.msm_impl(),
                       "backend": jax.default_backend()}))
     return True
 
@@ -906,6 +927,7 @@ def bench_msm(fast: bool) -> bool:
         "vs_baseline": round(value / baseline, 3),
         "backend": result.get("backend"),
         "msm_mode": result.get("msm_mode", bench_msm_mode()),
+        "msm_impl": result.get("msm_impl"),
         "impl": result.get("impl"),
         "fallback": fallback,
         "self_verify": os.environ.get("SPECTRE_SELF_VERIFY", "always"),

@@ -119,7 +119,15 @@ def cneg(mask, p):
 
     One field negation + select — the device half of signed-digit /
     GLV sign handling (a negated point replaces 2^(c-1)..2^c bucket work,
-    and a negated half-scalar replaces ~127 doublings)."""
+    and a negated half-scalar replaces ~127 doublings).
+
+    Infinity caveat: -(0:1:0) = (0:p-1:0), a NON-CANONICAL representative
+    of the same point (Z = 0). That is fine everywhere cneg output feeds
+    `padd` — the complete formulas treat any Z = 0 input as the identity —
+    but it means bucket/accumulator states are only representative-equal,
+    never bit-equal, once a masked infinity has passed through. Compare
+    via decode_points (or a Z-normalizing hash), not raw limbs; the
+    in-kernel mirror `msm_pallas._k_cneg` inherits the same contract."""
     return select_point(mask, pneg(p), p)
 
 

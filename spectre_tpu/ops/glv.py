@@ -8,11 +8,16 @@ turns one 254-bit MSM row into two ~127-bit rows over {P, phi(P)} — half the
 Pippenger window passes for a doubling of (cheap, embarrassingly parallel)
 point count. phi itself is ONE field multiply per point (ops.ec.endo).
 
-This module is deliberately host-side numpy/ints: the decomposition needs
-256-bit products and a rounded division — branchy bigint work that is wrong
-for the VPU — while its output (8-limb half-scalars + sign masks) is exactly
-the static-shape input the device kernels want. Cost is ~1e-5 s/scalar,
-noise against the MSM it feeds.
+Two implementations share the derived constants:
+
+  host (decompose/decompose_batch): numpy/ints — branchy bigint reference,
+      the oracle everything else is pinned against. ~1e-5 s/scalar.
+  device (decompose_device): the same lattice math as a traced jnp
+      carry-scan over 16-bit limbs, so the Pallas MSM path never round-trips
+      scalars through the host (a per-MSM serialization against the device
+      windows). The rounded division becomes a Barrett multiply by a
+      precomputed reciprocal plus ONE exact correction step — bit-exact
+      against the host floor division, pinned by tests/test_msm_modes.py.
 
 Constants are DERIVED at import (cube roots via the field generators, the
 short lattice basis via truncated extended-Euclid per the GLV paper) and
@@ -23,6 +28,8 @@ from __future__ import annotations
 
 import functools
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..fields import bn254
@@ -166,3 +173,183 @@ def decompose_limbs16(sc16: np.ndarray):
     """[n, 16] 16-bit-limb scalars (the device MSM wire format) ->
     decompose_batch outputs."""
     return decompose_batch(L.limbs16_to_ints(np.asarray(sc16)))
+
+
+# ---------------------------------------------------------------------------
+# device-side decomposition (traced jnp; the Pallas MSM path)
+#
+# Exact-arithmetic plan, all over 16-bit limbs in uint32 lanes (limb-major
+# [L, n] so lax.scan carries run down the limb axis, lanes across scalars):
+#
+#   c1 = (2k*b2 + r) // (2r)   c2 = (2k*|b1| + r) // (2r)      (b1 < 0)
+#
+# The floor division is a Barrett multiply by mu = floor(2^512 / 2r): for
+# x < 2^384 the estimate floor(x*mu >> 512) is q or q-1, never more off, so
+# ONE branchless correction (r_hat >= 2r) recovers the exact quotient. The
+# residuals k1 = k - c1*a1 - c2*a2 and k2 = c1*|b1| - c2*b2 are computed
+# mod 2^144 in two's complement (|k_i| < 2^126, so bit 143 is the sign).
+# ---------------------------------------------------------------------------
+
+_MASK16 = np.uint32(0xFFFF)
+
+
+def _int_limbs(v: int, nl: int) -> np.ndarray:
+    assert 0 <= v < 1 << (16 * nl), "constant overflows its limb count"
+    return np.array([(v >> (16 * i)) & 0xFFFF for i in range(nl)], np.uint32)
+
+
+@functools.cache
+def _device_consts():
+    """Static limb tables for decompose_device, derived (not transcribed)
+    from the same lattice basis the host path uses."""
+    (a1, b1), (a2, b2) = _constants()[2]
+    # the sign structure below is what the BN254 basis derives to; the
+    # device dataflow hardcodes it, so fail loudly if derivation changes
+    assert a1 > 0 and b1 < 0 and a2 > 0 and b2 > 0, \
+        "lattice basis sign structure changed — decompose_device is stale"
+    mu = (1 << 512) // (2 * R)
+    return {
+        "tb2": _int_limbs(2 * b2, 8),        # x1 multiplier
+        "tb1": _int_limbs(2 * (-b1), 8),     # x2 multiplier (|b1|)
+        "r24": _int_limbs(R, 24),            # dividend addend, 24-limb frame
+        "mu": _int_limbs(mu, 17),            # Barrett reciprocal of 2r
+        "d17": _int_limbs(2 * R, 17),        # divisor, correction frame
+        "a1": _int_limbs(a1, 8),
+        "a2": _int_limbs(a2, 8),
+        "nb1": _int_limbs(-b1, 8),
+        "b2": _int_limbs(b2, 8),
+    }
+
+
+def _carry_norm(t):
+    """Carry-propagate a limb-major accumulator (entries < 2^32) to
+    normalized 16-bit limbs; returns (limbs, top_carry)."""
+    def step(c, ti):
+        cur = ti + c
+        return cur >> 16, cur & _MASK16
+
+    top, outs = jax.lax.scan(step, jnp.zeros_like(t[0]), t)
+    return outs, top
+
+
+def _mul_const(aT, const_limbs: np.ndarray, out_l: int):
+    """Exact product of limb-major [La, n] (limbs < 2^16) with a static
+    nonnegative constant, low `out_l` limbs. CIOS-shaped scan (one round
+    per constant limb, emit the finished low limb, shift) minus the
+    Montgomery reduction; accumulator entries stay < 2^22 — uint32-safe."""
+    la = aT.shape[0]
+    lane = aT.shape[1:]
+    z1 = jnp.zeros((1,) + lane, jnp.uint32)
+
+    def rnd(t, bj):
+        prod = aT * bj
+        t = (t + jnp.concatenate([prod & _MASK16, z1], 0)
+             + jnp.concatenate([z1, prod >> 16], 0))
+        out = t[0] & _MASK16
+        carry = t[0] >> 16
+        t = jnp.concatenate([(t[1] + carry)[None], t[2:], z1], 0)
+        return t, out
+
+    t0 = jnp.zeros((la + 1,) + lane, jnp.uint32)
+    t, outs = jax.lax.scan(rnd, t0, jnp.asarray(const_limbs))
+    hi, top = _carry_norm(t)
+    full = jnp.concatenate([outs, hi, top[None]], 0)
+    if full.shape[0] >= out_l:
+        return full[:out_l]
+    pad = jnp.zeros((out_l - full.shape[0],) + lane, jnp.uint32)
+    return jnp.concatenate([full, pad], 0)
+
+
+def _add_const(aT, const_limbs: np.ndarray):
+    """a + const mod 2^(16L), limb-major carry scan."""
+    cl = jnp.asarray(const_limbs)[:aT.shape[0]]
+    pad = aT.shape[0] - cl.shape[0]
+    if pad:
+        cl = jnp.concatenate([cl, jnp.zeros((pad,), jnp.uint32)])
+
+    def step(c, ab):
+        ai, bi = ab
+        cur = ai + bi + c
+        return cur >> 16, cur & _MASK16
+
+    _top, outs = jax.lax.scan(
+        step, jnp.zeros_like(aT[0]), (aT, jnp.broadcast_to(
+            cl[:, None] if aT.ndim == 2 else cl, aT.shape)))
+    return outs
+
+
+def _sub_mod(aT, bT):
+    """(a - b) mod 2^(16L) and the final borrow lane (1 where a < b)."""
+    def step(borrow, ab):
+        ai, bi = ab
+        cur = ai - bi - borrow
+        return (cur >> 16) & np.uint32(1), cur & _MASK16
+
+    borrow, outs = jax.lax.scan(
+        step, jnp.zeros_like(aT[0]), (aT, bT))
+    return outs, borrow
+
+
+def _neg_mod(aT):
+    """Two's-complement negation mod 2^(16L)."""
+    def step(c, ai):
+        cur = (ai ^ _MASK16) + c
+        return cur >> 16, cur & _MASK16
+
+    _top, outs = jax.lax.scan(
+        step, jnp.ones_like(aT[0]), aT)
+    return outs
+
+
+def _floor_div_2r(xT):
+    """Exact floor(x / 2r) for limb-major x [24, n] (< 2^384): Barrett
+    estimate then one correction. Returns [9, n] (quotients < 2^128)."""
+    cst = _device_consts()
+    qhat = _mul_const(xT, cst["mu"], 41)[32:41]            # (x*mu) >> 512
+    # r_hat = x - qhat*2r mod 2^272; true value in [0, 4r) < 2^272 => exact
+    qd = _mul_const(qhat, cst["d17"], 17)
+    rhat, _ = _sub_mod(xT[:17], qd)
+    d17 = jnp.broadcast_to(jnp.asarray(cst["d17"])[:, None], rhat.shape)
+    _, borrow = _sub_mod(rhat, d17)
+    return _add_lane(qhat, (borrow == 0).astype(jnp.uint32))
+
+
+def _add_lane(aT, bit):
+    """a + bit (per-lane 0/1) mod 2^(16L)."""
+    def step(c, ai):
+        cur = ai + c
+        return cur >> 16, cur & _MASK16
+
+    _top, outs = jax.lax.scan(step, bit, aT)
+    return outs
+
+
+# module-level jitted entry point (trace-cache hygiene lint root)
+TRACE_JIT_ROOTS = ("decompose_device",)
+
+
+@jax.jit
+def decompose_device(sc16):
+    """[n, 16] standard-form limb scalars (values < r, the wire format) ->
+    (abs1 [n, 8], abs2 [n, 8], neg1 [n] bool, neg2 [n] bool), bit-exact
+    against decompose_batch — same Babai rounding, same signs."""
+    cst = _device_consts()
+    kT = jnp.transpose(jnp.asarray(sc16, jnp.uint32))      # [16, n]
+    x1 = _add_const(_mul_const(kT, cst["tb2"], 24), cst["r24"])
+    x2 = _add_const(_mul_const(kT, cst["tb1"], 24), cst["r24"])
+    c1 = _floor_div_2r(x1)                                 # [9, n]
+    c2 = _floor_div_2r(x2)
+    k9 = kT[:9]                                            # k mod 2^144
+    k1, _ = _sub_mod(k9, _mul_const(c1, cst["a1"], 9))
+    k1, _ = _sub_mod(k1, _mul_const(c2, cst["a2"], 9))
+    k2, _ = _sub_mod(_mul_const(c1, cst["nb1"], 9),
+                     _mul_const(c2, cst["b2"], 9))
+
+    def finish(v):
+        negm = (v[8] >> 15) & np.uint32(1)                 # sign bit 143
+        mag = jnp.where(negm[None] != 0, _neg_mod(v), v)
+        return jnp.transpose(mag[:HALF_LIMBS]), negm != 0
+
+    abs1, neg1 = finish(k1)
+    abs2, neg2 = finish(k2)
+    return abs1, abs2, neg1, neg2

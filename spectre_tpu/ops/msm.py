@@ -85,10 +85,13 @@ MSM_IMPLS = ("xla", "pallas")
 def msm_impl() -> str:
     """Active MSM implementation from SPECTRE_MSM_IMPL (default: xla).
 
-    `pallas` routes the vanilla mode's bucket loop through the fused SoA
-    complete-add kernel (`ops/msm_pallas.py`; interpret-mode off-TPU).
-    Non-vanilla modes keep the XLA path — the GLV/fixed digit plumbing is
-    AoS — and record a degrade event so provenance shows the fallback."""
+    `pallas` routes EVERY mode's bucket phase through the VMEM-resident
+    bucket kernel (`ops/msm_pallas.py`; interpret-mode off-TPU): vanilla
+    recodes the full scalars to signed digits, glv/glv+signed decompose
+    on device (glv.decompose_device) and share the signed kernel, fixed
+    feeds its endo-expanded window tables as SoA blocks. Only the mesh-
+    sharded and DP-batch runners stay XLA — those degrade visibly
+    (_record_pallas_degrade)."""
     impl = os.environ.get("SPECTRE_MSM_IMPL", "xla")
     if impl not in MSM_IMPLS:
         raise ValueError(
@@ -299,18 +302,64 @@ def _apply_sign(points, neg):
     return ec.cneg(neg, points)
 
 
+def _glv_scalars_device(scalars):
+    """(sc2 [2n, 8], neg [2n]) via the TRACED decomposition — no host
+    round trip (glv.decompose_device matches decompose_batch bit-exactly,
+    so every impl/mode keeps byte-identical results)."""
+    from . import glv
+    a1, a2, n1, n2 = glv.decompose_device(jnp.asarray(scalars))
+    return (jnp.concatenate([a1, a2], axis=0),
+            jnp.concatenate([n1, n2], axis=0))
+
+
 def glv_split(points, scalars):
-    """Host+device GLV prep: (points2 [2n,3,16], sc2 [2n,8], neg [2n]).
+    """Device GLV prep: (points2 [2n,3,16], sc2 [2n,8], neg [2n]).
 
     points2 = [P ; phi(P)] WITHOUT signs applied — the signed-digit kernel
     folds `neg` into its digit-sign mask; the unsigned path applies it with
-    `_apply_sign` once."""
+    `_apply_sign` once. The Babai rounding runs on device
+    (glv.decompose_device) so scalar prep never serializes against the
+    device windows."""
+    sc2, neg = _glv_scalars_device(scalars)
+    return _expand_endo(points), sc2, neg
+
+
+def _msm_pallas(points, scalars, c, mode: str, base_key):
+    """SPECTRE_MSM_IMPL=pallas dispatch: every mode through the
+    VMEM-resident bucket kernel (ops/msm_pallas). Mode differences that
+    change the group-element computation shape are preserved (GLV point
+    expansion, fixed-base tables, table-budget degrade); digit recoding is
+    canonicalized to signed digits in-kernel — the same group element with
+    half the bucket columns, pinned byte-identical by tests."""
+    from . import msm_pallas as MP
+
+    n = points.shape[0]
+    if mode == "vanilla":
+        # cap at 11: the kernel keeps all nwin bucket arrays VMEM-resident
+        # and 254-bit scalars triple nwin vs the GLV paths (see the VMEM
+        # budget note in msm_pallas)
+        cc = c if c is not None else min(default_window(n), 11)
+        return MP.combine_windows_soa(
+            MP.msm_bucket_windows(MP.to_soa(points), scalars, None, cc, 254),
+            cc)
+
     from . import glv
-    a1, a2, n1, n2 = glv.decompose_limbs16(np.asarray(scalars))
-    pts2 = _expand_endo(points)
-    sc2 = jnp.asarray(np.concatenate([a1, a2], axis=0))
-    neg = jnp.asarray(np.concatenate([n1, n2], axis=0))
-    return pts2, sc2, neg
+    nbits = glv.glv_bits()
+    if mode == "fixed":
+        cf = c if c is not None else default_window_fixed(2 * n)
+        if _degrade_fixed(n, cf, nbits):
+            mode = "glv+signed"
+        else:
+            nwin = (nbits + cf) // cf
+            sc2, neg = _glv_scalars_device(scalars)
+            table = fixed_base_table(points, cf, nwin, base_key=base_key)
+            return MP.msm_bucket_fixed(
+                MP.to_soa_windows(table), sc2, neg, cf, nbits)
+
+    cc = c if c is not None else default_window(2 * n, signed=True)
+    pts2, sc2, neg = glv_split(points, scalars)
+    return MP.combine_windows_soa(
+        MP.msm_bucket_windows(MP.to_soa(pts2), sc2, neg, cc, nbits), cc)
 
 
 # ---------------------------------------------------------------------------
@@ -459,6 +508,19 @@ def _fixed_fits_budget(n: int, c: int, nbits: int) -> bool:
     return _fixed_table_bytes(n, c, nbits) <= _TABLES.budget
 
 
+def _record_pallas_degrade(mode: str, n, c, site: str):
+    """SPECTRE_MSM_IMPL=pallas asked for the fused kernel but `site` has no
+    pallas lowering (the mesh-sharded and DP-batch runners are XLA
+    shard_map programs): fall back to XLA VISIBLY — a ServiceHealth counter
+    (`spectre_msm_pallas_degraded_total` in /metrics) plus a provenance
+    event carrying enough detail (mode, n, c, caller site) to find the
+    half-covered path from a farm manifest."""
+    from ..utils.health import HEALTH
+    HEALTH.incr("msm_pallas_degraded")
+    _record_event("msm_pallas_unsupported_mode", mode=mode, n=int(n),
+                  c=None if c is None else int(c), site=site)
+
+
 def _degrade_fixed(n: int, c: int, nbits: int) -> bool:
     """Graceful degradation (ISSUE 3): when one fixed-base table would
     exceed the SPECTRE_MSM_TABLE_MB budget, fall back to glv+signed
@@ -587,17 +649,12 @@ def msm(points, scalars, c: int | None = None, mode: str | None = None,
     if mode not in MSM_MODES:
         raise ValueError(f"unknown MSM mode {mode!r}")
     n = points.shape[0]
-    impl = msm_impl()
+    if msm_impl() == "pallas":
+        return _msm_pallas(points, scalars, c, mode, base_key)
     if mode == "vanilla":
         if c is None:
             c = default_window(n)
-        if impl == "pallas":
-            from . import msm_pallas as MP
-            return MP.msm_soa(MP.to_soa(points), scalars, c)
         return combine_windows(msm_windows(points, scalars, c), c)
-    if impl == "pallas":
-        # GLV/fixed digit plumbing is AoS-only: degrade to XLA, visibly
-        _record_event("msm_pallas_unsupported_mode", mode=mode)
 
     from . import glv
     nbits = glv.glv_bits()
@@ -607,9 +664,7 @@ def msm(points, scalars, c: int | None = None, mode: str | None = None,
             mode = "glv+signed"
         else:
             nwin = (nbits + cf) // cf
-            a1, a2, n1, n2 = glv.decompose_limbs16(np.asarray(scalars))
-            sc2 = jnp.asarray(np.concatenate([a1, a2], axis=0))
-            neg = jnp.asarray(np.concatenate([n1, n2], axis=0))
+            sc2, neg = _glv_scalars_device(scalars)
             table = fixed_base_table(points, cf, nwin, base_key=base_key)
             return msm_fixed_run(table, sc2, neg, cf, nbits)
 
@@ -648,6 +703,12 @@ def msm_batch(points, scalars_batch, c: int | None = None,
     parallel batch axis lives in parallel.batch_msm."""
     mode = mode if mode is not None else msm_mode()
     n = points.shape[0]
+    if msm_impl() == "pallas":
+        # per-row dispatch through the bucket pipeline: the fixed table is
+        # LRU-shared across rows and every trace below is a cached jit
+        return jnp.stack([
+            _msm_pallas(points, sc, c, mode, base_key)
+            for sc in scalars_batch])
     if mode == "vanilla":
         if c is None:
             c = default_window(n)
@@ -665,9 +726,7 @@ def msm_batch(points, scalars_batch, c: int | None = None,
             nwin = (nbits + cf) // cf
             table = fixed_base_table(points, cf, nwin, base_key=base_key)
             for sc in scalars_batch:
-                a1, a2, n1, n2 = glv.decompose_limbs16(np.asarray(sc))
-                sc2 = jnp.asarray(np.concatenate([a1, a2], axis=0))
-                neg = jnp.asarray(np.concatenate([n1, n2], axis=0))
+                sc2, neg = _glv_scalars_device(sc)
                 outs.append(msm_fixed_run(table, sc2, neg, cf, nbits))
             return jnp.stack(outs)
 
@@ -675,9 +734,7 @@ def msm_batch(points, scalars_batch, c: int | None = None,
     if c is None:
         c = default_window(2 * n, signed=(mode == "glv+signed"))
     for sc in scalars_batch:
-        a1, a2, n1, n2 = glv.decompose_limbs16(np.asarray(sc))
-        sc2 = jnp.asarray(np.concatenate([a1, a2], axis=0))
-        neg = jnp.asarray(np.concatenate([n1, n2], axis=0))
+        sc2, neg = _glv_scalars_device(sc)
         if mode == "glv":
             wins = msm_windows_bits(_apply_sign(pts2, neg), sc2, c, nbits)
         else:

@@ -34,6 +34,7 @@ MASK16 = np.uint32(0xFFFF)
 LANE = 128
 
 _P_LIMBS = tuple(int(v) for v in F.fq_ctx().p_limbs)   # BN254 Fq
+_ONE_LIMBS = tuple(int(v) for v in F.fq_ctx().one_mont)
 _N0 = np.uint32(F.fq_ctx().n0inv16)
 
 
@@ -206,9 +207,10 @@ def _padd_kernel(p_ref, q_ref, o_ref):
 
 # module-level jitted entry points (trace-cache hygiene lint roots):
 # analysis/trace_lint verifies each name below is a stable module-level
-# jit; the pallas_call below lives INSIDE a jit-decorated function, so
+# jit; the pallas_calls below live INSIDE jit-decorated functions, so
 # the outer jit caches its trace (exempt from TC-FRESH-JIT by design).
-TRACE_JIT_ROOTS = ("_padd_soa_call", "msm_windows_soa")
+TRACE_JIT_ROOTS = ("_padd_soa_call", "_bucket_sums",
+                   "_bucket_windows_jit", "_bucket_fixed_jit")
 
 
 @functools.partial(jax.jit, static_argnames=("block", "interpret"))
@@ -256,49 +258,99 @@ def padd_soa(p, q, block: int = 2048):
 
 
 # ---------------------------------------------------------------------------
-# MSM on SoA arrays (segmented-reduction Pippenger, as ops/msm.py)
+# MSM on SoA arrays: VMEM-resident bucket accumulation (Pippenger)
+#
+# The bucket phase runs INSIDE one Pallas kernel instead of the old XLA
+# argsort + emission-slot reduction. Grid = (point block,): the FULL
+# [nwin, 48, 2^(c-1)] bucket tensor stays resident in VMEM across the
+# block axis (the out BlockSpec ignores the block index — the standard
+# revisiting-accumulator pattern), the window axis is an in-kernel
+# fori_loop (keeps the trace constant-size: interpret mode inlines the
+# body once per GRID step, so windows must not be grid), and point blocks
+# stream through the pallas pipeline — which on TPU is exactly the
+# double-buffered DMA the bucket method wants. Digits are SIGNED
+# (ops/msm.signed_digit_stream), so the bucket array is half of 2^c; the
+# digit sign and the GLV half-scalar sign fold into ONE conditional-negate
+# mask per point. Column j holds bucket j+1 (weight j+1); digit 0 matches
+# no column and is a free skip.
+#
+# VMEM budget: nwin * 48 * 2^(c-1) * 4 bytes resident — 0.8 MB at the
+# production GLV window (c=11, nwin=12), 7.9 MB at c=13; the vanilla path
+# caps its default window at 11 (254-bit scalars triple nwin) to stay
+# inside the ~16 MB arena next to the streamed point blocks.
 # ---------------------------------------------------------------------------
 
-def _segmented_bucket_sums_soa(points, digits, nbuckets: int):
-    """points [48, n] (n a power of two), digits [n] in [0, nbuckets]
-    (nbuckets = sentinel/skip) -> [48, nbuckets] bucket sums.
+def _inf_col():
+    """[48, 1] projective infinity (0:1:0) built IN-TRACE from scalar
+    literals — same TC-CONST-CAPTURE constraint as _p_col: a pallas kernel
+    body may not capture traced array constants."""
+    idx = jax.lax.broadcasted_iota(jnp.uint32, (ROWS, 1), 0)
+    col = jnp.zeros((ROWS, 1), jnp.uint32)
+    for i, v in enumerate(_ONE_LIMBS):
+        if v:
+            col = jnp.where(idx == np.uint32(NL + i), np.uint32(v), col)
+    return col
 
-    Emission slots are laid out with stride nbuckets+1 per level: the last
-    slot of each level's block is the trash slot for non-emitting lanes
-    (sentinel pairs), discarded before the tree reduction."""
-    n = points.shape[1]
-    order = jnp.argsort(digits, stable=True)
-    buckets = digits[order]
-    pts = points[:, order]
-    levels = n.bit_length() - 1
-    stride = nbuckets + 1
 
-    emissions = inf_soa((levels + 1) * stride)
-    for lvl in range(levels):
-        left, right = pts[:, 0::2], pts[:, 1::2]
-        bl, br = buckets[0::2], buckets[1::2]
-        same = bl == br
-        merged = padd_soa(left, right)
-        pts = jnp.where(same[None, :], merged, right)
-        emit_idx = lvl * stride + jnp.where(same, nbuckets, bl)
-        emissions = emissions.at[:, emit_idx].set(left, mode="drop")
-        buckets = br
-    emissions = emissions.at[:, levels * stride + buckets[0]].set(
-        pts[:, 0], mode="drop")
+def _k_cneg(mask, arr):
+    """Conditional projective negation on [48, T]: y -> p - y where mask.
+    Infinity is safe: _k_sub normalizes p - 0 back to 0, so (0:1:0)
+    negates to itself bit-for-bit."""
+    y = arr[NL:2 * NL]
+    ny = _k_sub(jnp.zeros_like(y), y)
+    return jnp.concatenate(
+        [arr[:NL], jnp.where(mask, ny, y), arr[2 * NL:]], axis=0)
 
-    # drop trash slots, tree-reduce over the level axis
-    acc = emissions.reshape(ROWS, levels + 1, stride)[:, :, :nbuckets]
-    k = levels + 1
-    while k > 1:
-        half = k // 2
-        merged = padd_soa(
-            acc[:, :half].reshape(ROWS, half * nbuckets),
-            acc[:, half:2 * half].reshape(ROWS, half * nbuckets),
-        ).reshape(ROWS, half, nbuckets)
-        acc = (jnp.concatenate([merged, acc[:, 2 * half:]], axis=1)
-               if k % 2 else merged)
-        k = acc.shape[1]
-    return acc[:, 0]
+
+def _k_bucket_accumulate(pts, digs, negs, buckets):
+    """One point block into the resident bucket tensor (pure jnp body — the
+    kernel below is a ref-shim around it; kernel-lint traces it directly).
+
+    pts [P, 48, B] SoA points (P = 1: one base shared by every window;
+    P = nwin: fixed-base per-window tables); digs [nwin, B] int32 signed
+    digits in [-2^(c-1)+1, 2^(c-1)]; negs [1, B] uint32 0/1 GLV sign mask;
+    buckets [nwin, 48, NB] with column j = bucket j+1. Per (window, point):
+    one conditional negate (digit sign XOR GLV sign), one full-width
+    complete add against the window's bucket array (the [48, 1] point
+    column broadcasts through _k_padd), and a one-hot column select — the
+    serial chain is the bucket method's data dependence; the lane axis
+    runs across the 2^(c-1) buckets."""
+    nwin, _, nb = buckets.shape
+    npts = pts.shape[-1]
+    shared = pts.shape[0] == 1
+    lane1 = jax.lax.broadcasted_iota(jnp.int32, (1, nb), 1) + 1
+
+    def win(w, bks):
+        acc = jax.lax.dynamic_slice(bks, (w, 0, 0), (1, ROWS, nb))[0]
+        dw = jax.lax.dynamic_slice(digs, (w, 0), (1, npts))
+        pw = pts[0] if shared else jax.lax.dynamic_slice(
+            pts, (w, 0, 0), (1, ROWS, npts))[0]
+
+        def body(i, a):
+            d = jax.lax.dynamic_slice(dw, (0, i), (1, 1))
+            g = jax.lax.dynamic_slice(negs, (0, i), (1, 1))
+            col = jax.lax.dynamic_slice(pw, (0, i), (ROWS, 1))
+            eff = _k_cneg(jnp.logical_xor(d < 0, g != 0), col)
+            cand = _k_padd(a, eff)
+            return jnp.where(lane1 == jnp.abs(d), cand, a)
+
+        acc = jax.lax.fori_loop(0, npts, body, acc)
+        return jax.lax.dynamic_update_slice(bks, acc[None], (w, 0, 0))
+
+    return jax.lax.fori_loop(0, nwin, win, buckets)
+
+
+def _bucket_kernel(d_ref, g_ref, p_ref, o_ref):
+    from jax.experimental import pallas as pl
+
+    nwin, _, nb = o_ref.shape
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        o_ref[...] = jnp.broadcast_to(_inf_col()[None], (nwin, ROWS, nb))
+
+    o_ref[...] = _k_bucket_accumulate(
+        p_ref[...], d_ref[...], g_ref[...], o_ref[...])
 
 
 def _aggregate_buckets_soa(bucket_sums, c: int):
@@ -327,28 +379,141 @@ def _aggregate_buckets_soa(bucket_sums, c: int):
     return acc
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
-def msm_windows_soa(points, scalars, c: int):
-    """Per-window partial MSM sums: points [48, n] SoA Montgomery, scalars
-    [n, 16] standard-form 16-bit limbs -> [48, nwin]."""
+@functools.partial(jax.jit, static_argnames=("nb", "block", "interpret"))
+def _bucket_sums(points, digs, negs, nb: int, block: int, interpret: bool):
+    """pallas_call wrapper: digits [nwin, n], negs [1, n], points either
+    [48, n] (shared base) or [nwin, 48, n] (fixed-base window tables) ->
+    [nwin, 48, nb] bucket sums. Grid = point blocks only: the bucket
+    tensor is initialized at block 0 and revisited — VMEM-resident — until
+    the last block is folded in, while the input specs stream the next
+    point/digit block through the pipeline DMA. Jitted at module level
+    (trace-cache root) even though its callers are themselves jitted —
+    inner jit caches compose for free and keep the pallas_call under a
+    stable trace cache for any future direct caller."""
+    from jax.experimental import pallas as pl
+
+    nwin, n = digs.shape
+    if points.ndim == 2:
+        points = points[None]
+    nper = points.shape[0]
+    return pl.pallas_call(
+        _bucket_kernel,
+        out_shape=jax.ShapeDtypeStruct((nwin, ROWS, nb), jnp.uint32),
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((nwin, block), lambda j: (0, j)),
+            pl.BlockSpec((1, block), lambda j: (0, j)),
+            pl.BlockSpec((nper, ROWS, block), lambda j: (0, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((nwin, ROWS, nb), lambda j: (0, 0, 0)),
+        interpret=interpret,
+    )(digs, negs, points)
+
+
+def _signed_inputs(points, scalars, neg, c: int, nwin: int, gran: int):
+    """Shared digit/sign/padding prep for the bucket pipeline: returns
+    (points, digs [nwin, n_pad] int32, negs [1, n_pad] uint32). Padding
+    points are infinity with digit 0 — skipped inside the kernel."""
     from . import msm as MSM
 
-    nwin = (254 + c - 1) // c
-    nbuckets = 1 << c
-    n = points.shape[1]
-    n_pad = max(1 << ((n - 1).bit_length() if n > 1 else 1), LANE)
+    n = scalars.shape[0]
+    digs = MSM.signed_digit_stream(scalars, c, nwin)
+    negs = (jnp.zeros((n,), jnp.uint32) if neg is None
+            else jnp.asarray(neg).astype(jnp.uint32))
+    n_pad = -(-n // gran) * gran
     if n_pad != n:
-        points = jnp.concatenate([points, inf_soa(n_pad - n)], axis=1)
+        pad = n_pad - n
+        if points.ndim == 3:
+            points = jnp.concatenate(
+                [points, jnp.broadcast_to(
+                    inf_soa(pad)[None], (points.shape[0], ROWS, pad))],
+                axis=2)
+        else:
+            points = jnp.concatenate([points, inf_soa(pad)], axis=1)
+        digs = jnp.pad(digs, ((0, 0), (0, pad)))
+        negs = jnp.pad(negs, (0, pad))
+    return points, digs, negs[None]
 
-    def one_window(w):
-        d = MSM._digits_traced(scalars, w, c)
-        if n_pad != n:
-            d = jnp.concatenate(
-                [d, jnp.full((n_pad - n,), nbuckets, dtype=d.dtype)])
-        return _segmented_bucket_sums_soa(points, d, nbuckets)
 
-    sums = jax.lax.map(one_window, jnp.arange(nwin))     # [nwin, 48, nb]
-    return _aggregate_buckets_soa(jnp.transpose(sums, (1, 0, 2)), c)
+@functools.partial(jax.jit, static_argnames=("c", "nbits", "interpret"))
+def _bucket_windows_jit(points, scalars, neg, c: int, nbits: int,
+                        interpret: bool):
+    """Raw bucket sums via the kernel: points [48, n] SoA, scalars [n, L]
+    limb magnitudes, neg [n] sign mask (or None) -> [nwin, 48, nb].
+
+    Deliberately jits ONLY the digit prep + pallas bucket stage: the
+    weighted aggregation runs eagerly through padd_soa's own per-shape jit.
+    Inlining it here would splice every interpret-mode padd body of the
+    reduction tree into one jaxpr, and XLA-CPU's LLVM compile time is
+    superlinear in program size (~75s vs ~15s for the split pipeline at
+    tiny shapes)."""
+    nwin = (nbits + c) // c          # ceil((nbits + 1) / c): carry room
+    nb = 1 << (c - 1)
+    gran = 8 if interpret else LANE
+    points, digs, negs = _signed_inputs(points, scalars, neg, c, nwin, gran)
+    block = _legal_block(points.shape[-1], 1024) if not interpret \
+        else points.shape[-1]
+    return _bucket_sums(points, digs, negs, nb, block, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("c", "nbits", "interpret"))
+def _bucket_fixed_jit(table, scalars, neg, c: int, nbits: int,
+                      interpret: bool):
+    """Fixed-base variant: table [nwin, 48, N] SoA window tables
+    (T[w] = 2^{cw} * base, endo-expanded), scalars [N, L], neg [N] ->
+    [nwin, 48, nb] raw bucket sums (same jit-scope split as
+    _bucket_windows_jit)."""
+    nwin = (nbits + c) // c
+    nb = 1 << (c - 1)
+    gran = 8 if interpret else LANE
+    table, digs, negs = _signed_inputs(table, scalars, neg, c, nwin, gran)
+    block = _legal_block(table.shape[-1], 1024) if not interpret \
+        else table.shape[-1]
+    return _bucket_sums(table, digs, negs, nb, block, interpret)
+
+
+def _with_zero_bucket(acc):
+    """[48, nwin, nb] -> [48, nwin, nb+1]: column j holds bucket j+1, so
+    prepend the weight-0 bucket and the shared weighted aggregation
+    (weight = column index) applies unchanged."""
+    nwin = acc.shape[1]
+    return jnp.concatenate(
+        [jnp.broadcast_to(_inf_col()[:, None], (ROWS, nwin, 1)), acc],
+        axis=2)
+
+
+def msm_bucket_windows(points, scalars, neg, c: int, nbits: int):
+    """[48, nwin] per-window sums (interpret mode resolved per call)."""
+    sums = _bucket_windows_jit(points, scalars, neg, c, nbits, _interpret())
+    return _aggregate_buckets_soa(
+        _with_zero_bucket(jnp.transpose(sums, (1, 0, 2))), c)
+
+
+def msm_bucket_fixed(table, scalars, neg, c: int, nbits: int):
+    """[3, 16] projective result for a fixed-base window table: bucket sums
+    merge ACROSS windows before one aggregation and the combine chain
+    disappears (same structure as msm.msm_fixed_run)."""
+    sums = _bucket_fixed_jit(table, scalars, neg, c, nbits, _interpret())
+    acc = jnp.transpose(sums, (1, 0, 2))                  # [48, nwin, nb]
+    nb = acc.shape[2]
+    k = acc.shape[1]
+    while k > 1:
+        half = k // 2
+        merged = padd_soa(
+            acc[:, :half].reshape(ROWS, half * nb),
+            acc[:, half:2 * half].reshape(ROWS, half * nb),
+        ).reshape(ROWS, half, nb)
+        acc = (jnp.concatenate([merged, acc[:, 2 * half:]], axis=1)
+               if k % 2 else merged)
+        k = acc.shape[1]
+    out = _aggregate_buckets_soa(_with_zero_bucket(acc), c)
+    return from_soa(out)[0]
+
+
+def to_soa_windows(table):
+    """[nwin, N, 3, 16] AoS window tables -> [nwin, 48, N] SoA."""
+    nwin, n = table.shape[0], table.shape[1]
+    return jnp.transpose(table, (0, 2, 3, 1)).reshape(nwin, ROWS, n)
 
 
 def combine_windows_soa(window_sums, c: int):
@@ -361,9 +526,12 @@ def combine_windows_soa(window_sums, c: int):
 
 def msm_soa(points, scalars, c: int | None = None):
     """Full MSM: points [48, n] SoA Montgomery, scalars [n, 16] standard
-    limbs. Returns [3, 16] projective Montgomery (AoS, as ops/msm.msm)."""
+    limbs. Returns [3, 16] projective Montgomery (AoS, as ops/msm.msm).
+    Signed-digit recode of the full 254-bit scalars — same group element
+    as the unsigned vanilla path, half the bucket columns."""
     n = points.shape[1]
     if c is None:
         from . import msm as MSM
         c = MSM.default_window(n)
-    return combine_windows_soa(msm_windows_soa(points, scalars, c), c)
+    return combine_windows_soa(
+        msm_bucket_windows(points, scalars, None, c, 254), c)

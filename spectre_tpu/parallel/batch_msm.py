@@ -137,6 +137,11 @@ def batch_msm_dp(points, scalars_batch, c: int | None = None,
     n = points.shape[0]
     if c is None:
         c = MSM.default_window(n, signed=signed)
+    if MSM.msm_impl() == "pallas":
+        # the DP shard_map runner has no pallas lowering — fall back to
+        # XLA visibly (health counter + provenance event, ops/msm.py)
+        MSM._record_pallas_degrade(MSM.msm_mode(), n, c,
+                                   "parallel.batch_msm_dp")
     mesh = mesh or _batch_mesh()
     ndev = mesh.shape["batch"]
     b = scalars_batch.shape[0]

@@ -327,6 +327,11 @@ class TpuBackend(CpuBackend):
         SM = importlib.import_module("spectre_tpu.parallel.sharded_msm")
 
         mode = MSM.msm_mode()
+        if MSM.msm_impl() == "pallas":
+            # the shard_map mesh program has no pallas lowering — fall
+            # back to XLA visibly (health counter + provenance event)
+            MSM._record_pallas_degrade(mode, m, None,
+                                       "backend._msm_sharded")
         plan = current_plan()
         sc16 = L16.u64limbs_to_u16limbs(scalars[:m])
         nbits, signed = 254, False

@@ -129,29 +129,71 @@ class TestInterpretMode:
         assert out.shape == (MP.ROWS, a.shape[0])
 
 
-@pytest.mark.skipif(not os.environ.get("RUN_SLOW"),
-                    reason="many compile shapes (set RUN_SLOW=1)")
-class TestSegmentedMsm:
-    def test_msm_soa_matches_host(self):
-        """Full SoA MSM (padd_soa monkeypatched to the jit'd kernel math —
-        same code the pallas kernel runs, minus Mosaic) vs the host MSM."""
-        n = 24
+class TestBucketKernel:
+    """The VMEM-resident bucket accumulation (this PR): the pure jnp body
+    `_k_bucket_accumulate` is testable without pallas_call, same pattern as
+    TestKernelMath; one small-shape test runs the REAL pallas_call pipeline
+    in interpret mode."""
+
+    def test_cneg_matches_ec(self, batch):
+        a, _ = batch
+        soa = MP.to_soa(a)
+        mask = jnp.asarray([[True, False] * (a.shape[0] // 2)])
+        got = jax.jit(MP._k_cneg)(mask, soa)
+        want = MP.to_soa(ec.cneg(mask[0], a))
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+    def test_cneg_keeps_infinity_at_infinity(self):
+        # -(0:1:0) = (0:-1:0): a different representative of the SAME
+        # point (Z = 0) — the complete padd treats both as identity
+        inf = MP.inf_soa(4)
+        got = jax.jit(MP._k_cneg)(jnp.ones((1, 4), bool), inf)
+        assert ec.decode_points(MP.from_soa(got)) == [None] * 4
+        assert np.array_equal(np.asarray(got[:MP.NL]),
+                              np.asarray(inf[:MP.NL]))       # x untouched
+        assert np.array_equal(np.asarray(got[2 * MP.NL:]),
+                              np.asarray(inf[2 * MP.NL:]))   # z untouched
+
+    @pytest.mark.slow
+    def test_accumulate_matches_manual_buckets(self, batch):
+        """One window, signed digits + GLV signs: the kernel body's bucket
+        array must equal per-bucket ec sums of the (conditionally negated)
+        points. slow marker: the nested fori_loop body costs a ~40s
+        XLA-CPU compile; `make test` (no marker filter) runs it."""
+        a, _ = batch
+        n = a.shape[0]
+        nb = 4
+        digs = jnp.asarray([[1, -2, 0, 2, 4, -1, 2, 3][:n]], jnp.int32)
+        negs = jnp.asarray([[0, 1, 0, 0, 1, 0, 0, 1][:n]], jnp.uint32)
+        buckets = jnp.broadcast_to(MP.inf_soa(1)[:, :1][None],
+                                   (1, MP.ROWS, nb))
+        got = jax.jit(MP._k_bucket_accumulate)(
+            MP.to_soa(a)[None], digs, negs, buckets)
+        eff = ec.cneg(np.asarray(
+            (np.asarray(digs)[0] < 0) ^ (np.asarray(negs)[0] != 0)), a)
+        for j in range(nb):
+            want = ec.inf_point(())
+            for i in range(n):
+                if abs(int(digs[0, i])) == j + 1:
+                    want = ec.padd(eff[i], want)
+            assert ec.decode_points(
+                MP.from_soa(got[0])[j][None]) == ec.decode_points(
+                    jnp.asarray(want)[None])
+
+    @pytest.mark.skipif(not os.environ.get("RUN_SLOW"),
+                        reason="85 eager window aggregations (RUN_SLOW=1); "
+                               "tier-1 parity lives in test_msm_modes")
+    def test_bucket_pipeline_matches_host_msm(self):
+        """The REAL pallas_call bucket pipeline (interpret mode) end to
+        end: msm_soa (signed recode, VMEM-resident buckets, weighted
+        aggregation) vs the host curve."""
+        n = 8
         pts = _pts(n, seed=5)
         scalars = [(7919 * k + 13) % bn.R for k in range(n)]
         from spectre_tpu.ops import limbs as L
         soa = MP.to_soa(ec.encode_points(pts))
         sc = jnp.asarray(L.ints_to_limbs16(scalars))
-
-        def jnp_padd(p, q, block=None):
-            return _jit_padd(p, q)
-
-        orig = MP.padd_soa
-        MP.padd_soa = jnp_padd
-        try:
-            wins = MP.msm_windows_soa.__wrapped__(soa, sc, 4)
-            res = MP.combine_windows_soa(wins, 4)
-        finally:
-            MP.padd_soa = orig
+        res = MP.msm_soa(soa, sc, c=3)
         got = ec.decode_points(jnp.asarray(res)[None])[0]
         want = bn.g1_curve.msm(pts, scalars)
         assert (int(got[0]), int(got[1])) == (int(want[0]), int(want[1]))

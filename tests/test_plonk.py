@@ -149,6 +149,42 @@ class TestMsmModeCommitments:
             assert got == oracle, \
                 f"SPECTRE_MSM_MODE={mode} commitment diverged from oracle"
 
+    @pytest.mark.slow
+    def test_pallas_impl_commitments_byte_identical(self, srs, monkeypatch):
+        """ISSUE 17 tier of the same gate, impl axis: every mode under
+        SPECTRE_MSM_IMPL=pallas (interpret mode off-TPU) commits to the
+        SAME bytes as the CPU oracle through the device backend, and none
+        of the four modes falls back to XLA (zero unsupported-mode
+        events). Slow tier: four interpret-mode pallas compile chains at
+        K=7 cost ~100s on the 1-core box; the fast tier covers the same
+        matrix at MSM level in test_msm_modes."""
+        import random
+
+        from spectre_tpu.ops import msm as MSM
+        rng = random.Random(0xD16E57)
+        n = srs.n
+        coeffs = np.zeros((n, 4), dtype=np.uint64)
+        for i in range(n):
+            v = rng.randrange(bn.R)
+            for j in range(4):
+                coeffs[i, j] = (v >> (64 * j)) & 0xFFFFFFFFFFFFFFFF
+        oracle = kzg.commit(srs, coeffs, B.get_backend("cpu"))
+        events = []
+        orig = MSM._record_event
+        monkeypatch.setattr(
+            MSM, "_record_event",
+            lambda name, **kw: (events.append((name, kw)),
+                                orig(name, **kw)))
+        monkeypatch.setenv("SPECTRE_MSM_IMPL", "pallas")
+        bk = B.get_backend("tpu")
+        for mode in ("glv+signed", "glv", "fixed", "vanilla"):
+            monkeypatch.setenv("SPECTRE_MSM_MODE", mode)
+            got = kzg.commit(srs, coeffs, bk)
+            assert got == oracle, \
+                f"impl=pallas mode={mode} commitment diverged from oracle"
+        bad = [e for e in events if e[0] == "msm_pallas_unsupported_mode"]
+        assert not bad, f"pallas path degraded to XLA: {bad}"
+
 
 def _tiny_circuit(cfg):
     """x + x*y = out, x range-checked, one constant pin."""
@@ -526,6 +562,44 @@ class TestBackendByteEquality:
             p = prove(pk, srs, asg, bk, blinding_rng=self._seeded_rng(7))
             assert p == base, \
                 f"SPECTRE_MSM_MODE={mode} diverged from vanilla proof bytes"
+
+    @pytest.mark.skipif(not os.environ.get("SPECTRE_BYTEEQ_FULL"),
+                        reason="this box's XLA CPU LLVM segfaults under "
+                               "repeated prove compile churn; opt in with "
+                               "SPECTRE_BYTEEQ_FULL=1 (real-device tier)")
+    def test_msm_impl_proof_bytes_identical(self, srs, monkeypatch):
+        """ISSUE 17 acceptance gate, impl axis: SPECTRE_MSM_IMPL=pallas
+        must produce BYTE-IDENTICAL proofs to xla through the device
+        backend for every MSM mode, with zero unsupported-mode fallbacks
+        in the glv/glv+signed/fixed runs. Same full-prove tier as the mode
+        gate above (the commitment-level pallas sweep rides the slow tier
+        in TestMsmModeCommitments)."""
+        from spectre_tpu.ops import msm as MSM
+        cfg = CircuitConfig(k=K, num_advice=1, num_lookup_advice=1,
+                            num_fixed=1, lookup_bits=4)
+        advice, lookup, fixed, selectors, copies, out = _tiny_circuit(cfg)
+        asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
+        bk = B.get_backend("tpu")
+        events = []
+        orig = MSM._record_event
+        monkeypatch.setattr(
+            MSM, "_record_event",
+            lambda name, **kw: (events.append((name, kw)),
+                                orig(name, **kw)))
+        for mode in ("vanilla", "glv", "glv+signed", "fixed"):
+            monkeypatch.setenv("SPECTRE_MSM_MODE", mode)
+            monkeypatch.setenv("SPECTRE_MSM_IMPL", "xla")
+            pk = keygen(srs, cfg, fixed, selectors, copies, bk)
+            base = prove(pk, srs, asg, bk, blinding_rng=self._seeded_rng(11))
+            events.clear()
+            monkeypatch.setenv("SPECTRE_MSM_IMPL", "pallas")
+            p = prove(pk, srs, asg, bk, blinding_rng=self._seeded_rng(11))
+            assert p == base, \
+                f"mode={mode}: pallas proof bytes diverge from xla"
+            if mode != "vanilla":
+                bad = [e for e in events
+                       if e[0] == "msm_pallas_unsupported_mode"]
+                assert not bad, f"mode={mode} degraded to XLA: {bad}"
 
     def test_seeded_blinding_is_deterministic_and_fresh_is_not(self, srs):
         cfg = CircuitConfig(k=K, num_advice=1, num_lookup_advice=1, num_fixed=1,
