@@ -1,13 +1,13 @@
 # Build orchestration (reference parity: `justfile` recipes).
 
-.PHONY: all native test test-slow test-faults test-farm test-gateway fixtures bench bench-fast bench-multichip bench-serve setup-committee setup-step lint lint-fast lint-deep tpu-evidence report-ci
+.PHONY: all native test test-slow test-faults test-farm test-farm-proc test-gateway fixtures bench bench-fast bench-multichip bench-serve setup-committee setup-step lint lint-fast lint-deep tpu-evidence report-ci
 
 all: native
 
 native:
 	$(MAKE) -C spectre_tpu/native
 
-test: native lint lint-deep test-faults test-farm test-gateway bench-fast
+test: native lint lint-deep test-faults test-farm test-farm-proc test-gateway bench-fast
 	python -m pytest tests/ -q
 
 # fault-injection tier (PR 3, grown in PR 6): deterministic resilience
@@ -42,6 +42,16 @@ test-faults: native
 # UpdateStore 10k-period RSS bound.
 test-farm: native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_farm.py -q
+
+# real-process failover drill (PR 18, tests/test_farm_proc.py): three
+# actual serve() subprocesses announce themselves to an empty dispatcher
+# head, one is SIGKILLed mid-prove -> exactly one lease takeover, a
+# byte-identical final proof, and TTL deregistration of the corpse; plus
+# lease-journal replay across a killed dispatcher PROCESS. Skips cleanly
+# where fork+HTTP is unavailable; the `timeout` wrapper is the hard
+# wall-clock budget (subprocesses each pay a jax import).
+test-farm-proc: native
+	timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_farm_proc.py -q
 
 # light-client serving gateway (PR 14, tests/test_gateway.py): HTTP
 # cache semantics (digest ETags stable across restarts, 304s, immutable

@@ -33,6 +33,21 @@ class NativeVerifier:
         return verify(self.vk, self.srs, [list(instances)], proof)
 
 
+class EvmProofVerifier:
+    """Runs proofs through the GENERATED Solidity verifier in the EVM
+    simulator — the closest thing to on-chain verification the repo can
+    do (ISSUE 18 aggregation cadence publishes through this). Construct
+    with the output of ``evm.gen_evm_verifier``; each ``verify`` call
+    deploys + calls the contract in ``evm.simulator``."""
+
+    def __init__(self, sol_src: str):
+        self.sol_src = sol_src
+
+    def verify(self, instances, proof) -> bool:
+        from ..evm.simulator import run_verifier
+        return run_verifier(self.sol_src, list(instances), proof)
+
+
 @dataclass
 class StepInput:
     """Mirror of the Solidity step input struct
@@ -70,6 +85,11 @@ class SpectreContract:
     block_header_roots: dict = field(default_factory=dict)
     execution_payload_roots: dict = field(default_factory=dict)
     sync_committee_poseidons: dict = field(default_factory=dict)
+    # ISSUE 18 aggregation cadence: end-period -> published window
+    # record; `agg_verifier` gates publishes (falls back to the rotate
+    # verifier — the window tip IS a committee-class proof)
+    aggregated_ranges: dict = field(default_factory=dict)
+    agg_verifier: object = None
 
     def __post_init__(self):
         self.sync_committee_poseidons[self.initial_sync_period] = \
@@ -106,3 +126,31 @@ class SpectreContract:
         assert next_period not in self.sync_committee_poseidons, \
             "period already rotated"
         self.sync_committee_poseidons[next_period] = next_committee_poseidon
+
+    def publish_aggregate(self, start_period: int, period: int,
+                          committee_poseidon, instances, proof: bytes,
+                          calldata=None) -> dict:
+        """Publish an aggregation-cadence proof covering committee
+        periods ``[start_period, period]`` (ISSUE 18). The proof is
+        verified by ``agg_verifier`` (the generated EVM verifier via
+        :class:`EvmProofVerifier` in drills; ``rotate_verifier``
+        otherwise). Replay-safe: re-publishing the IDENTICAL window is
+        an idempotent no-op (crash between publish and journal append),
+        but a conflicting proof for an already-published end period is
+        refused."""
+        period, start_period = int(period), int(start_period)
+        assert start_period <= period, "empty aggregation window"
+        prior = self.aggregated_ranges.get(period)
+        if prior is not None:
+            assert prior["committee_poseidon"] == committee_poseidon \
+                and prior["start_period"] == start_period, \
+                f"period {period} already aggregated with different content"
+            return prior
+        verifier = self.agg_verifier or self.rotate_verifier
+        assert verifier.verify(list(instances), proof), \
+            "aggregation proof invalid"
+        rec = {"start_period": start_period, "period": period,
+               "committee_poseidon": committee_poseidon,
+               "calldata": calldata}
+        self.aggregated_ranges[period] = rec
+        return rec

@@ -59,7 +59,8 @@ class Follower:
     def __init__(self, spec, beacon, jobs, store: UpdateStore | None = None,
                  directory: str | None = None, pubkeys=None, domain=None,
                  backfill: int | None = None, health=HEALTH,
-                 clock=time.monotonic):
+                 clock=time.monotonic, cadence_periods: int | None = None,
+                 publisher=None):
         if store is None:
             if directory is None:
                 raise ValueError("Follower needs a store or a directory")
@@ -72,7 +73,9 @@ class Follower:
                                    domain=domain, backfill=backfill,
                                    health=health)
         self.scheduler = ProofScheduler(jobs, store, health=health,
-                                        clock=clock)
+                                        clock=clock,
+                                        cadence_periods=cadence_periods,
+                                        publisher=publisher)
         self.degraded = False
         self.cycles = 0
         add = getattr(jobs, "add_live_provider", None)
@@ -134,5 +137,6 @@ class Follower:
             "chain_ok": self.store.verify_chain(),
             "degraded": self.degraded,
             "cycles": self.cycles,
+            "agg_cadence_periods": self.scheduler.cadence_periods,
         })
         return snap

@@ -31,32 +31,95 @@ job id and provenance-manifest digest (the flight-recorder linkage). A
 store write failure (e.g. injected ENOSPC) counts on
 ``follower_store_write_failures`` and retries next cycle — the job
 result is still journaled, nothing is lost.
+
+Aggregation cadence (ISSUE 18): with ``SPECTRE_AGG_CADENCE_PERIODS=N``
+(or ``cadence_periods=N``), every N sealed committee periods the
+scheduler derives an :class:`~spectre_tpu.follower.tracker.AggregationDue`
+window purely from the update store — no beacon involved — and submits
+the ``genEvmProof_AggregationCadence`` circuit over the stored chain.
+The done proof is published through the configured
+:class:`AggregationPublisher` (the EVM-verifiable Spectre contract
+surface) BEFORE being journaled as an ``aggregate`` record, so a
+publish failure (``follower_publish_failures``) retries next cycle with
+the finished job kept, and a restart re-derives exactly the unpublished
+windows (``store.has_aggregate`` is the dedup key). Aggregation items
+sort after committees and steps: compressing history must never starve
+the live chain.
 """
 
 from __future__ import annotations
 
+import os
 import time
 
 from ..prover_service.jobs import ServiceOverloaded
 from ..utils.health import HEALTH
 from ..utils.profiling import phase
-from .tracker import CommitteeUpdateDue
+from .tracker import AggregationDue, CommitteeUpdateDue
 from .updates import ChainOrderError
 
 RETRY_BASE_S = 1.0
 RETRY_CAP_S = 60.0
 
+CADENCE_ENV = "SPECTRE_AGG_CADENCE_PERIODS"
+CADENCE_DEFAULT = 0                      # 0 = cadence disabled
+
+
+class PublicationError(RuntimeError):
+    """Publishing an aggregation proof to the contract surface failed
+    (simulator rejected the calldata, replay refused, transport broke).
+    The scheduler keeps the finished job and retries next cycle."""
+
+
+class AggregationPublisher:
+    """Publishes a completed aggregation window through the Spectre
+    contract surface (``contracts/spectre.py``) — in tests and drills
+    the contract's verifier runs the generated Solidity through
+    ``evm.simulator``, so a publish IS an EVM verification."""
+
+    def __init__(self, contract, health=HEALTH):
+        self.contract = contract
+        self.health = health
+
+    def publish(self, item, result: dict) -> None:
+        from ..prover_service.selfverify import decode_result
+        try:
+            proof, instances = decode_result(result)
+            self.contract.publish_aggregate(
+                start_period=item.start_period,
+                period=item.period,
+                committee_poseidon=result.get("committee_poseidon"),
+                instances=instances,
+                proof=proof,
+                calldata=result.get("calldata"),
+            )
+        except Exception as exc:
+            raise PublicationError(
+                f"aggregation window [{item.start_period}, {item.period}] "
+                f"rejected: {exc}") from exc
+        self.health.incr("follower_aggregations_published")
+
 
 class ProofScheduler:
     def __init__(self, jobs, store, health=HEALTH, clock=time.monotonic,
                  retry_base_s: float = RETRY_BASE_S,
-                 retry_cap_s: float = RETRY_CAP_S):
+                 retry_cap_s: float = RETRY_CAP_S,
+                 cadence_periods: int | None = None,
+                 publisher: AggregationPublisher | None = None):
         self.jobs = jobs
         self.store = store
         self.health = health
         self._clock = clock
         self.retry_base_s = retry_base_s
         self.retry_cap_s = retry_cap_s
+        if cadence_periods is None:
+            try:
+                cadence_periods = int(os.environ.get(CADENCE_ENV)
+                                      or CADENCE_DEFAULT)
+            except ValueError:
+                cadence_periods = CADENCE_DEFAULT
+        self.cadence_periods = max(0, int(cadence_periods))
+        self.publisher = publisher
         # key -> {"item", "jid", "attempts", "not_before"}
         self._pending: dict[tuple, dict] = {}
 
@@ -67,6 +130,8 @@ class ProofScheduler:
     def _satisfied(self, item) -> bool:
         if isinstance(item, CommitteeUpdateDue):
             return self.store.has_committee(item.period)
+        if isinstance(item, AggregationDue):
+            return self.store.has_aggregate(item.period)
         return self.store.has_step(item.slot)
 
     def offer(self, items) -> int:
@@ -86,11 +151,14 @@ class ProofScheduler:
         """One scheduling cycle: submit every eligible item (committee
         items first), then collect finished jobs into the store."""
         summary = {"submitted": 0, "stored": 0, "failed": 0, "shed": 0}
+        self._offer_cadence()
         now = self._clock()
         entries = sorted(
             self._pending.items(),
             key=lambda kv: (0 if isinstance(kv[1]["item"],
-                                            CommitteeUpdateDue) else 1,
+                                            CommitteeUpdateDue)
+                            else 2 if isinstance(kv[1]["item"],
+                                                 AggregationDue) else 1,
                             kv[0][1]))
         for key, ent in entries:
             if self._pending.get(key) is not ent:
@@ -102,6 +170,50 @@ class ProofScheduler:
             if ent["jid"] is not None:
                 self._collect(key, ent, summary, now)
         return summary
+
+    def _offer_cadence(self):
+        """Derive due aggregation windows from the update store: one
+        per ``cadence_periods`` sealed committee periods, anchored at
+        the chain anchor. A window is due once its end period is sealed
+        (strictly below the tip — its successor pins it, so the window
+        contents can never change) and no ``aggregate`` record exists
+        for it yet; a window with a mid-chain hole (quarantined record)
+        is skipped this cycle (``follower_cadence_holes``) and
+        re-derived once the chain heals."""
+        n = self.cadence_periods
+        if n <= 0:
+            return
+        anchor = self.store.anchor_period()
+        tip = self.store.tip_period()
+        if anchor is None or tip is None:
+            return
+        for p in range(anchor + n - 1, tip, n):
+            key = ("aggregation", p)
+            if key in self._pending or self.store.has_aggregate(p):
+                continue
+            start = p - n + 1
+            chain = []
+            for q in range(start, p + 1):
+                rec = self.store.get_committee(q)
+                if rec is None:
+                    break
+                res = rec.get("result") or {}
+                chain.append({
+                    "period": rec["period"],
+                    "prev_poseidon": rec.get("prev_poseidon"),
+                    "committee_poseidon": res.get("committee_poseidon"),
+                    "proof": res.get("proof"),
+                    "instances": res.get("instances"),
+                    "calldata": res.get("calldata"),
+                })
+            if len(chain) != n:
+                self.health.incr("follower_cadence_holes")
+                continue
+            item = AggregationDue(p, start, {
+                "start_period": start, "period": p, "chain": chain})
+            self._pending[key] = {"item": item, "jid": None,
+                                  "attempts": 0, "not_before": 0.0}
+            self.health.incr("follower_cadence_windows")
 
     def _submit(self, ent: dict, summary: dict):
         item = ent["item"]
@@ -160,6 +272,13 @@ class ProofScheduler:
                 # yet) — keep the finished job until it lands
                 self.health.incr("follower_chain_order_rejected")
                 return
+            except PublicationError:
+                # the contract surface refused or broke: the proof is
+                # done and journaled — keep the finished job and retry
+                # the publish next cycle
+                self.health.incr("follower_publish_failures")
+                self._backoff(ent, now, keep_job=True)
+                return
             except OSError:
                 # diskfull & friends: the job result is still journaled;
                 # retry the append next cycle
@@ -185,6 +304,17 @@ class ProofScheduler:
         manifest_digest = getattr(job, "manifest_digest", None)
         if isinstance(item, CommitteeUpdateDue):
             self.store.append_committee(item.period, job.result,
+                                        job_id=job.id,
+                                        manifest_digest=manifest_digest)
+        elif isinstance(item, AggregationDue):
+            # publish BEFORE journaling: has_aggregate() is the dedup
+            # key, so a window must never be marked done while its
+            # proof is unpublished — a crash between publish and append
+            # merely re-publishes (the contract's replay guard absorbs)
+            if self.publisher is not None:
+                self.publisher.publish(item, job.result)
+            self.store.append_aggregate(item.period, job.result,
+                                        start_period=item.start_period,
                                         job_id=job.id,
                                         manifest_digest=manifest_digest)
         else:

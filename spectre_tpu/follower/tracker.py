@@ -26,7 +26,8 @@ from __future__ import annotations
 import dataclasses
 import os
 
-from ..prover_service.rpc import RPC_METHOD_COMMITTEE, RPC_METHOD_STEP
+from ..prover_service.rpc import (RPC_METHOD_AGG, RPC_METHOD_COMMITTEE,
+                                  RPC_METHOD_STEP)
 from ..utils.health import HEALTH
 from ..utils.profiling import phase
 
@@ -67,6 +68,27 @@ class CommitteeUpdateDue:
 
     def key(self):
         return ("committee", self.period)
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregationDue:
+    """A cadence window of sealed committee periods awaiting the
+    aggregation/compression proof (ISSUE 18). Emitted by the scheduler
+    (not the tracker): the window is derived purely from the update
+    store, so no beacon access is involved. `period` is the window END
+    (the dedup key via ``store.has_aggregate``); `start_period` opens
+    the window; `params` carries the stored chain records the replica
+    re-links and re-verifies host-side."""
+    period: int
+    start_period: int
+    params: dict            # genEvmProof_AggregationCadence RPC params
+
+    @property
+    def method(self) -> str:
+        return RPC_METHOD_AGG
+
+    def key(self):
+        return ("aggregation", self.period)
 
 
 def _unwrap(payload):

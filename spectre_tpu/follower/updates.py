@@ -194,6 +194,11 @@ class UpdateStore:
                                       cache_periods, health=health)
         self._steps = _JournalMap(self.path, "step", "slot",
                                   cache_periods, health=health)
+        # period -> aggregation record (ISSUE 18 cadence): keyed by the
+        # window's END period, so has_aggregate(boundary) is the
+        # scheduler's restart-safe "already published" dedup check
+        self._aggregates = _JournalMap(self.path, "aggregate", "period",
+                                       cache_periods, health=health)
         # lowest committee period ever journaled — the chain's trust
         # anchor. Survives in-memory invalidations (a dropped record is
         # re-proved, not forgotten) so the tracker can re-derive holes
@@ -256,7 +261,9 @@ class UpdateStore:
                     self._anchor = period
             elif rec.get("kind") == "step":
                 self._steps.put(int(rec["slot"]), rec, offset)
-        if self._committee or self._steps:
+            elif rec.get("kind") == "aggregate":
+                self._aggregates.put(int(rec["period"]), rec, offset)
+        if self._committee or self._steps or self._aggregates:
             self.health.incr("follower_journal_replays")
         self._verify_tip()
 
@@ -352,6 +359,32 @@ class UpdateStore:
         self._notify("step", slot)
         return rec
 
+    def append_aggregate(self, period: int, result: dict,
+                         start_period: int | None = None,
+                         job_id: str | None = None,
+                         manifest_digest: str | None = None) -> dict:
+        """Store a published aggregation proof for the cadence window
+        ending at `period` (ISSUE 18). No chain-order gate: each window
+        stands alone (the underlying committee chain already links it),
+        so the only invariant is one record per boundary period — the
+        scheduler's restart-safe dedup key."""
+        period = int(period)
+        with self._lock:
+            digest = self.store.write(_canonical(result),
+                                      suffix=UPDATE_SUFFIX)
+            rec = {"kind": "aggregate", "period": period,
+                   "start_period": (None if start_period is None
+                                    else int(start_period)),
+                   "digest": digest,
+                   "committee_poseidon": result.get("committee_poseidon"),
+                   "job_id": job_id, "manifest_digest": manifest_digest,
+                   "ts": time.time()}
+            offset = self._append(rec)
+            self._aggregates.put(period, rec, offset)
+        self.health.incr("follower_aggregates_stored")
+        self._notify("aggregate", period)
+        return rec
+
     # -- read (serving path: O(artifact read), no prover involved) ---------
 
     def _load(self, rec: dict) -> dict | None:
@@ -365,6 +398,9 @@ class UpdateStore:
         if rec["kind"] == "committee":
             out["period"] = rec["period"]
             out["prev_poseidon"] = rec.get("prev_poseidon")
+        elif rec["kind"] == "aggregate":
+            out["period"] = rec["period"]
+            out["start_period"] = rec.get("start_period")
         else:
             out["slot"] = rec["slot"]
         out["result"] = result
@@ -392,6 +428,17 @@ class UpdateStore:
             out = self._load(rec)
             if out is None:
                 del self._steps[int(slot)]
+                self.health.incr("follower_updates_invalidated")
+            return out
+
+    def get_aggregate(self, period: int) -> dict | None:
+        with self._lock:
+            rec = self._aggregates.get(int(period))
+            if rec is None:
+                return None
+            out = self._load(rec)
+            if out is None:
+                del self._aggregates[int(period)]
                 self.health.incr("follower_updates_invalidated")
             return out
 
@@ -435,6 +482,14 @@ class UpdateStore:
     def has_step(self, slot: int) -> bool:
         with self._lock:
             return int(slot) in self._steps
+
+    def has_aggregate(self, period: int) -> bool:
+        with self._lock:
+            return int(period) in self._aggregates
+
+    def latest_aggregate_period(self) -> int | None:
+        with self._lock:
+            return max(self._aggregates) if self._aggregates else None
 
     def tip_period(self) -> int | None:
         with self._lock:
@@ -501,7 +556,8 @@ class UpdateStore:
         resident index only — no record loads, regardless of chain
         length."""
         with self._lock:
-            digs = self._committee.digests() | self._steps.digests()
+            digs = self._committee.digests() | self._steps.digests() \
+                | self._aggregates.digests()
         return {(d, UPDATE_SUFFIX) for d in digs}
 
     def snapshot(self) -> dict:
@@ -509,8 +565,11 @@ class UpdateStore:
             return {
                 "committees": len(self._committee),
                 "steps": len(self._steps),
+                "aggregates": len(self._aggregates),
                 "tip_period": max(self._committee) if self._committee
                 else None,
                 "latest_step_slot": max(self._steps) if self._steps
                 else None,
+                "latest_aggregate_period": max(self._aggregates)
+                if self._aggregates else None,
             }

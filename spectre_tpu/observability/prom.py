@@ -190,6 +190,22 @@ def render(health=None, jobs=None, registry=None) -> str:
                 else:
                     v = r[key]
                 _sample(out, mn, {"replica": r["replica_id"]}, v)
+        _family(out, "spectre_replica_heartbeat_age_s", "gauge",
+                "Seconds since the replica's last announce heartbeat "
+                "(dynamic members only; past the TTL the member is "
+                "demoted and deregistered)")
+        for r in replicas:
+            age = r.get("last_heartbeat_age_s")
+            if age is not None:
+                _sample(out, "spectre_replica_heartbeat_age_s",
+                        {"replica": r["replica_id"]}, age)
+        _family(out, "spectre_dispatcher_members", "gauge",
+                "Proof-farm membership size by kind (total vs "
+                "announce-registered dynamic members)")
+        _sample(out, "spectre_dispatcher_members", {"kind": "total"},
+                len(replicas))
+        _sample(out, "spectre_dispatcher_members", {"kind": "dynamic"},
+                sum(1 for r in replicas if r.get("dynamic")))
 
     try:
         from ..follower.daemon import follower_snapshot

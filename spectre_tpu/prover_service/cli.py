@@ -83,6 +83,23 @@ def main(argv=None):
                         "$SPECTRE_REPLICA_LEASE_S or 120): a replica "
                         "owns a job only while its heartbeat renews "
                         "within this window")
+    r.add_argument("--announce-to", default=None,
+                   help="dispatcher head URL to announce this replica "
+                        "to (default $SPECTRE_ANNOUNCE_URL): joins the "
+                        "proof farm dynamically via registerReplica "
+                        "with a capability record + heartbeat (ISSUE 18)")
+    r.add_argument("--announce-interval", type=float, default=None,
+                   help="seconds between announce heartbeats (default "
+                        "$SPECTRE_ANNOUNCE_INTERVAL_S or 15)")
+    r.add_argument("--advertise-url", default=None,
+                   help="URL the dispatcher should dial back (default "
+                        "http://<host>:<port> of this server — set when "
+                        "behind NAT/a proxy)")
+    r.add_argument("--ttl-s", type=float, default=None,
+                   help="dispatcher-side heartbeat TTL for dynamic "
+                        "members (default $SPECTRE_REPLICA_TTL_S or "
+                        "60): a silent replica is demoted through its "
+                        "breaker and deregistered after this long")
     r.add_argument("--trace-dir", default=None,
                    help="write each completed job's span tree as Chrome "
                    "trace-event JSON (<job_id>.trace.json) under this "
@@ -137,6 +154,10 @@ def main(argv=None):
     f.add_argument("--pack-periods", type=int, default=None,
                    help="periods per sealed update pack (default "
                         "$SPECTRE_PACK_PERIODS or 8)")
+    f.add_argument("--agg-cadence", type=int, default=None,
+                   help="publish an EVM-verifiable aggregation proof "
+                        "every N sealed committee periods (default "
+                        "$SPECTRE_AGG_CADENCE_PERIODS or 0 = off)")
     f.add_argument("--gateway-cache-mb", type=float, default=None,
                    help="gateway hot-cache byte budget in MB (default "
                         "$SPECTRE_GATEWAY_CACHE_MB or 64)")
@@ -211,12 +232,26 @@ def main(argv=None):
                 replicas=[HttpReplica(url, ProverClient(url))
                           for url in urls],
                 journal_dir=args.params_dir, lease_s=args.lease_s,
-                verify_state=state)
+                ttl_s=args.ttl_s, verify_state=state)
             print(f"dispatching over {len(urls)} replicas "
-                  f"(lease {dispatcher.lease_s:g}s, cross-verify on)",
+                  f"(lease {dispatcher.lease_s:g}s, heartbeat TTL "
+                  f"{dispatcher.ttl_s:g}s, cross-verify on)",
                   flush=True)
+        elif args.ttl_s is not None:
+            # dispatcher head with an EMPTY static fleet (ISSUE 18):
+            # every replica joins dynamically via registerReplica
+            from .dispatcher import Dispatcher
+            dispatcher = Dispatcher(replicas=[],
+                                    journal_dir=args.params_dir,
+                                    lease_s=args.lease_s,
+                                    ttl_s=args.ttl_s, verify_state=state)
+            print(f"dispatching over announce-only fleet (heartbeat TTL "
+                  f"{dispatcher.ttl_s:g}s)", flush=True)
         serve(state, args.host, args.port, job_timeout=args.job_timeout,
               dispatcher=dispatcher, replica_id=args.replica_id,
+              announce=args.announce_to,
+              announce_interval=args.announce_interval,
+              advertise_url=args.advertise_url,
               **queue_kw)
     elif args.cmd == "utils":
         _utils_cmd(args, spec)
@@ -276,8 +311,20 @@ def _follow_cmd(args, spec):
               flush=True)
     else:
         beacon = BeaconClient(beacon_urls[0])
+    publisher = None
+    if args.agg_cadence:
+        # aggregation cadence (ISSUE 18): publish through the Spectre
+        # contract reference model — swap in an EvmProofVerifier-backed
+        # contract to gate publishes on the generated Solidity verifier
+        from ..contracts.spectre import SpectreContract
+        from ..follower.scheduler import AggregationPublisher
+        contract = SpectreContract(spec, 0, 0)
+        publisher = AggregationPublisher(contract)
+        print(f"aggregation cadence: every {args.agg_cadence} sealed "
+              f"periods", flush=True)
     fol = Follower(spec, beacon, jobs, directory=args.params_dir,
-                   pubkeys=pubkeys, domain=domain, backfill=args.backfill)
+                   pubkeys=pubkeys, domain=domain, backfill=args.backfill,
+                   cadence_periods=args.agg_cadence, publisher=publisher)
     gateway = None
     if args.gateway:
         from ..gateway import Gateway

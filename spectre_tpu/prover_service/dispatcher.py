@@ -37,9 +37,29 @@ The Dispatcher is callable with the JobQueue runner signature
 ``(method, params, heartbeat=None)``, so ``ensure_jobs(state,
 runner=dispatcher)`` points an unchanged queue (and the follower above
 it) at the farm. Fault sites ``replica.dispatch`` / ``replica.health`` /
-``replica.lease`` (utils/faults.py) make the whole failover matrix
-drillable; every ``dispatcher_*`` counter rides HEALTH.snapshot() into
-``/healthz`` and ``/metrics`` with zero exporter changes.
+``replica.lease`` / ``replica.register`` (utils/faults.py) make the
+whole failover matrix drillable; every ``dispatcher_*`` counter rides
+HEALTH.snapshot() into ``/healthz`` and ``/metrics`` with zero exporter
+changes.
+
+ISSUE 18 makes the farm self-managing:
+
+* **Dynamic membership with liveness** — replicas announce themselves
+  (``registerReplica`` RPC -> :meth:`Dispatcher.register_remote`) with
+  a structured :class:`ReplicaCapabilities` record (device kind, memory
+  MB, mesh shape, supported methods, max k). Re-announcements are
+  heartbeats; a replica silent past ``SPECTRE_REPLICA_TTL_S`` is
+  demoted through its existing circuit breaker and deregistered
+  (:meth:`sweep_members`). Joins and leaves are fsync-journaled
+  (``dispatcher.members.jsonl``), replayed and compacted exactly like
+  the lease journal, so a dispatcher restart reconstructs the fleet —
+  every replayed member gets one fresh TTL window to re-announce.
+* **Capability-aware placement** — rendezvous hashing stays, but ranks
+  the *eligible* set first: aggregation/compression proves go to
+  replicas advertising a mesh or the largest memory, k-sized work to
+  replicas whose declared ``max_k`` covers the job. Only when no
+  capable replica is healthy does routing fall back to the rest,
+  visibly (``dispatcher_placement_fallbacks``).
 
 Importable without jax (prom.py pulls :func:`dispatcher_snapshot`);
 heavy prover imports stay inside the replica prove paths.
@@ -60,6 +80,12 @@ from ..utils.breaker import BreakerOpen, CircuitBreaker
 from ..utils.health import HEALTH
 
 LEASE_JOURNAL_NAME = "dispatcher.leases.jsonl"
+MEMBER_JOURNAL_NAME = "dispatcher.members.jsonl"
+
+TTL_ENV = "SPECTRE_REPLICA_TTL_S"
+TTL_DEFAULT_S = 60.0
+ANNOUNCE_ENV = "SPECTRE_ANNOUNCE_INTERVAL_S"
+ANNOUNCE_DEFAULT_S = 15.0
 
 # exclusion-map bound: digests of completed jobs are dropped eagerly;
 # this caps pathological churn (many distinct failing digests)
@@ -96,6 +122,92 @@ def _is_infra_error(exc: BaseException) -> bool:
     return getattr(exc, "code", None) in (-32001, -32603)
 
 
+# -- capability records -----------------------------------------------------
+
+
+class ReplicaCapabilities:
+    """Structured capability record a replica announces (ISSUE 18):
+    device kind, memory MB, mesh shape, the set of supported RPC
+    methods (None = all) and the largest circuit size (``max_k``) the
+    box can prove. ``url`` is where the dispatcher reaches the replica.
+    Every field is optional — an empty record constrains nothing, so a
+    capability-less fleet routes exactly like before."""
+
+    FIELDS = ("device", "memory_mb", "mesh_shape", "methods", "max_k", "url")
+
+    def __init__(self, device=None, memory_mb=None, mesh_shape=None,
+                 methods=None, max_k=None, url=None):
+        self.device = str(device) if device else None
+        self.memory_mb = float(memory_mb) if memory_mb is not None else None
+        self.mesh_shape = (tuple(int(x) for x in mesh_shape)
+                           if mesh_shape else None)
+        self.methods = set(methods) if methods else None
+        self.max_k = int(max_k) if max_k is not None else None
+        self.url = str(url) if url else None
+
+    @classmethod
+    def coerce(cls, value) -> "ReplicaCapabilities | None":
+        """Accept the structured record, a plain dict (the RPC wire
+        form), or — backward compatibility with the PR-11 surface — a
+        bare iterable of method names."""
+        if value is None or isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            return cls(**{k: v for k, v in value.items() if k in cls.FIELDS})
+        return cls(methods=value)
+
+    def supports_method(self, method: str) -> bool:
+        return self.methods is None or method in self.methods
+
+    def to_dict(self) -> dict:
+        return {
+            "device": self.device,
+            "memory_mb": self.memory_mb,
+            "mesh_shape": list(self.mesh_shape) if self.mesh_shape else None,
+            "methods": sorted(self.methods) if self.methods else None,
+            "max_k": self.max_k,
+            "url": self.url,
+        }
+
+    def __repr__(self):
+        return f"<ReplicaCapabilities {self.to_dict()}>"
+
+
+def capability_record(state=None, url: str | None = None) -> dict:
+    """Best-effort capability record for THIS host, announced by
+    ``serve()``'s announce loop. Memory comes from sysconf, the mesh
+    shape from ``SPECTRE_MESH_SHAPE`` (the parallel/ knob), device kind
+    and max k from the ProverState when one is given."""
+    rec: dict = {"device": None, "memory_mb": None, "mesh_shape": None,
+                 "methods": None, "max_k": None, "url": url}
+    try:
+        pages = os.sysconf("SC_PHYS_PAGES")
+        page = os.sysconf("SC_PAGE_SIZE")
+        rec["memory_mb"] = round(pages * page / 2 ** 20, 1)
+    except (AttributeError, OSError, ValueError):
+        pass
+    mesh = os.environ.get("SPECTRE_MESH_SHAPE", "")
+    if mesh.strip():
+        try:
+            rec["mesh_shape"] = [int(x) for x in
+                                 mesh.replace("x", ",").split(",")
+                                 if x.strip()]
+        except ValueError:
+            pass
+    if state is not None:
+        backend = getattr(state, "backend", None)
+        if backend is not None:
+            rec["device"] = type(backend).__name__.removesuffix(
+                "Backend").lower() or None
+        ks = [getattr(state, a, None) for a in ("k_step", "k_committee")]
+        if getattr(state, "compress", False):
+            ks.append(getattr(state, "k_agg", None))
+        ks = [k for k in ks if isinstance(k, int)]
+        if ks:
+            rec["max_k"] = max(ks)
+    return rec
+
+
 # -- replicas ---------------------------------------------------------------
 
 
@@ -104,11 +216,11 @@ class Replica:
 
     def __init__(self, replica_id: str, capabilities=None):
         self.replica_id = str(replica_id)
-        # None = all methods; otherwise the set of RPC methods served
-        self.capabilities = set(capabilities) if capabilities else None
+        # structured record; bare method-name sets coerce (PR-11 compat)
+        self.caps = ReplicaCapabilities.coerce(capabilities)
 
     def supports(self, method: str) -> bool:
-        return self.capabilities is None or method in self.capabilities
+        return self.caps is None or self.caps.supports_method(method)
 
     def healthy(self) -> bool:
         faults.check("replica.health")
@@ -167,10 +279,12 @@ class HttpReplica(Replica):
 
     def prove(self, method: str, params: dict, heartbeat=None) -> dict:
         faults.check("replica.dispatch")
-        from .rpc import (RPC_METHOD_COMMITTEE, RPC_METHOD_COMMITTEE_SUBMIT,
+        from .rpc import (RPC_METHOD_AGG, RPC_METHOD_AGG_SUBMIT,
+                          RPC_METHOD_COMMITTEE, RPC_METHOD_COMMITTEE_SUBMIT,
                           RPC_METHOD_STEP, RPC_METHOD_STEP_SUBMIT)
         submit = {RPC_METHOD_STEP: RPC_METHOD_STEP_SUBMIT,
-                  RPC_METHOD_COMMITTEE: RPC_METHOD_COMMITTEE_SUBMIT
+                  RPC_METHOD_COMMITTEE: RPC_METHOD_COMMITTEE_SUBMIT,
+                  RPC_METHOD_AGG: RPC_METHOD_AGG_SUBMIT,
                   }.get(method)
         if submit is None:
             return self.client._call(method, params)
@@ -213,14 +327,21 @@ class Dispatcher:
                  verify_state=None, health=HEALTH, clock=time.monotonic,
                  poll_s: float = 0.02, health_ttl_s: float = 5.0,
                  breaker_threshold: int | None = None,
-                 breaker_cooldown: float | None = None):
+                 breaker_cooldown: float | None = None,
+                 ttl_s: float | None = None,
+                 method_k: dict | None = None):
         self.lease_s = lease_s if lease_s is not None \
             else _env_float("SPECTRE_REPLICA_LEASE_S", 120.0)
+        self.ttl_s = ttl_s if ttl_s is not None \
+            else _env_float(TTL_ENV, TTL_DEFAULT_S)
         self.verify_state = verify_state
         self.health = health
         self._clock = clock
         self.poll_s = poll_s
         self.health_ttl_s = health_ttl_s
+        # per-method circuit-size hints for max-k placement; methods the
+        # dict (and the verify_state fallback) don't cover route unhinted
+        self.method_k = dict(method_k) if method_k else {}
         self._breaker_threshold = breaker_threshold \
             if breaker_threshold is not None \
             else _env_int("SPECTRE_REPLICA_CB_THRESHOLD", 5)
@@ -235,28 +356,117 @@ class Dispatcher:
         self._takeover_due: set[str] = set()    # digests with a dead lease
         self._active: dict[str, str] = {}       # digest -> replica id
         self._health_cache: dict[str, tuple] = {}
+        self._heartbeats: dict[str, float] = {}  # rid -> last announce
+        self._dynamic: set[str] = set()          # TTL-governed member ids
         self._queue = None                      # attached by ensure_jobs
         for r in replicas:
             self.register(r)
         self._journal_path = None
+        self._member_journal_path = None
         if journal_dir is not None:
             os.makedirs(journal_dir, exist_ok=True)
             self._journal_path = os.path.join(journal_dir, LEASE_JOURNAL_NAME)
+            self._member_journal_path = os.path.join(journal_dir,
+                                                     MEMBER_JOURNAL_NAME)
             self._replay_journal()
+            self._replay_members()
         _DISPATCHERS.add(self)
 
     # -- registration ------------------------------------------------------
 
-    def register(self, replica: Replica) -> None:
+    def register(self, replica: Replica, dynamic: bool = False) -> None:
         with self._lock:
-            if replica.replica_id in self._breakers:
+            if any(r.replica_id == replica.replica_id for r in self.replicas):
                 raise ValueError(f"duplicate replica id {replica.replica_id}")
             self.replicas.append(replica)
-            self._breakers[replica.replica_id] = CircuitBreaker(
-                threshold=self._breaker_threshold,
-                cooldown=self._breaker_cooldown,
-                health=self.health, counter_prefix="dispatcher_breaker")
+            if replica.replica_id not in self._breakers:
+                self._breakers[replica.replica_id] = CircuitBreaker(
+                    threshold=self._breaker_threshold,
+                    cooldown=self._breaker_cooldown,
+                    health=self.health, counter_prefix="dispatcher_breaker")
+            if dynamic:
+                self._dynamic.add(replica.replica_id)
+                self._heartbeats[replica.replica_id] = self._clock()
         self.health.incr("dispatcher_replicas_registered")
+
+    def register_remote(self, replica_id: str, url: str | None = None,
+                        capabilities=None, _journal: bool = True) -> dict:
+        """``registerReplica`` RPC entry: first announce joins the fleet
+        as a TTL-governed :class:`HttpReplica`; re-announces are
+        heartbeats that refresh the capability record. A re-join after a
+        TTL deregistration keeps the replica's existing breaker — an
+        open breaker stays open, so a flapping box earns readmission
+        through the half-open trial like any other failure."""
+        faults.check("replica.register")
+        rid = str(replica_id)
+        caps = ReplicaCapabilities.coerce(capabilities)
+        if caps is not None and url and caps.url is None:
+            caps.url = str(url)
+        with self._lock:
+            existing = next((r for r in self.replicas
+                             if r.replica_id == rid), None)
+        if existing is None:
+            if not url:
+                raise ValueError(
+                    f"registerReplica for {rid} needs a url to dial back")
+            from .rpc_client import ProverClient
+            replica = HttpReplica(
+                rid, ProverClient(url),
+                capabilities=caps or ReplicaCapabilities(url=url))
+            self.register(replica, dynamic=True)
+            self.health.incr("dispatcher_members_joined")
+            if _journal:
+                self._member_journal({
+                    "event": "join", "replica": rid, "url": url,
+                    "capabilities": replica.caps.to_dict(),
+                    "ts": time.time()})
+        else:
+            if caps is not None:
+                existing.caps = caps
+            if url and isinstance(existing, HttpReplica) \
+                    and url not in existing.client.urls:
+                existing.client.url = url   # replica moved (new port)
+            with self._lock:
+                self._heartbeats[rid] = self._clock()
+            self.health.incr("dispatcher_heartbeats")
+        return {"replica_id": rid, "ttl_s": self.ttl_s,
+                "members": len(self.replicas)}
+
+    def deregister(self, replica_id: str, reason: str = "manual") -> bool:
+        """Remove a replica from membership (journaled). Breaker and
+        dispatch stats survive, so a later re-join keeps its history."""
+        rid = str(replica_id)
+        with self._lock:
+            before = len(self.replicas)
+            self.replicas = [r for r in self.replicas
+                             if r.replica_id != rid]
+            removed = len(self.replicas) < before
+            self._dynamic.discard(rid)
+            self._heartbeats.pop(rid, None)
+            self._health_cache.pop(rid, None)
+        if removed:
+            self.health.incr("dispatcher_members_left")
+            self._member_journal({"event": "leave", "replica": rid,
+                                  "reason": reason, "ts": time.time()})
+        return removed
+
+    def sweep_members(self) -> list[str]:
+        """Liveness sweep (clock-driven — called from dispatch() and
+        snapshot(), no background thread): a dynamic member whose last
+        announce is older than ``ttl_s`` is demoted through its existing
+        circuit breaker (in-flight routing stops admitting it before it
+        is even gone) and then deregistered, journaled as a leave."""
+        now = self._clock()
+        with self._lock:
+            expired = [rid for rid in self._dynamic
+                       if now - self._heartbeats.get(rid, 0.0) > self.ttl_s]
+        for rid in expired:
+            br = self._breakers.get(rid)
+            while br is not None and br.state != "open":
+                br.record(False)
+            self.deregister(rid, reason="ttl")
+            self.health.incr("dispatcher_member_ttl_expired")
+        return expired
 
     def breaker(self, replica_id: str) -> CircuitBreaker:
         return self._breakers[replica_id]
@@ -371,6 +581,93 @@ class Dispatcher:
         except Exception:
             self.health.incr("dispatcher_lease_journal_failures")
 
+    # -- membership journal ------------------------------------------------
+
+    def _member_journal(self, rec: dict):
+        """fsync'd append of a join/leave — same tolerance contract as
+        the lease journal: IO errors keep the in-memory fleet authoritative,
+        counted on dispatcher_member_journal_failures."""
+        if self._member_journal_path is None:
+            return
+        try:
+            with open(self._member_journal_path, "a",
+                      encoding="utf-8") as f:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except Exception:
+            self.health.incr("dispatcher_member_journal_failures")
+
+    def _replay_members(self):
+        """Reconstruct the fleet from ``dispatcher.members.jsonl``: last
+        join/leave per replica id wins. A restored member re-dials its
+        announced url and gets ONE fresh TTL window — it either
+        re-announces (it survived the dispatcher restart) or the next
+        sweep deregisters it. Statically-registered ids are never
+        shadowed by the journal."""
+        try:
+            with open(self._member_journal_path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return
+        live: dict[str, dict] = {}
+        lines = 0
+        for line in raw.split(b"\n"):
+            if not line.strip():
+                continue
+            lines += 1
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue        # torn tail (crash mid-append)
+            ev = rec.get("event")
+            if ev == "join" and rec.get("replica"):
+                live[rec["replica"]] = rec
+            elif ev == "leave":
+                live.pop(rec.get("replica"), None)
+        for rid, rec in live.items():
+            url = rec.get("url")
+            if not url or any(r.replica_id == rid for r in self.replicas):
+                continue
+            try:
+                from .rpc_client import ProverClient
+                caps = ReplicaCapabilities.coerce(rec.get("capabilities")) \
+                    or ReplicaCapabilities(url=url)
+                self.register(HttpReplica(rid, ProverClient(url),
+                                          capabilities=caps), dynamic=True)
+                self.health.incr("dispatcher_members_replayed")
+            except Exception:
+                continue        # malformed record: membership is best-effort
+        if lines > len(live):
+            self._compact_members(live)
+
+    def _compact_members(self, live: dict):
+        """Rewrite the member journal to its replay fixpoint — one join
+        per live member — with the lease-compaction idiom: staged
+        sidecar, fsync, atomic replace; IO failures keep the full
+        history (dispatcher_member_compact_failures)."""
+        tmp = self._member_journal_path + ".compact"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                for rid in sorted(live):
+                    f.write(json.dumps(live[rid], sort_keys=True) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._member_journal_path)
+            try:
+                dfd = os.open(
+                    os.path.dirname(self._member_journal_path) or ".",
+                    os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                pass
+            self.health.incr("dispatcher_member_compactions")
+        except Exception:
+            self.health.incr("dispatcher_member_compact_failures")
+
     # -- routing -----------------------------------------------------------
 
     def _healthy_cached(self, replica: Replica) -> bool:
@@ -387,25 +684,88 @@ class Dispatcher:
         self._health_cache[replica.replica_id] = (now, ok)
         return ok
 
+    def _method_k(self, method: str) -> int | None:
+        """Circuit-size hint for max-k placement: an explicit
+        ``method_k`` entry wins, else the verify_state's own k knobs
+        (the dispatcher head is configured like its replicas)."""
+        if method in self.method_k:
+            return self.method_k[method]
+        vs = self.verify_state
+        if vs is None:
+            return None
+        if getattr(vs, "compress", False):
+            k = getattr(vs, "k_agg", None)
+        elif "Committee" in method or "Aggregation" in method:
+            k = getattr(vs, "k_committee", None)
+        else:
+            k = getattr(vs, "k_step", None)
+        return k if isinstance(k, int) else None
+
+    def _eligible(self, method: str) -> tuple[set, bool]:
+        """Capability-aware eligible set (ISSUE 18). Returns
+        ``(eligible_ids, constrained)`` — constrained=False means the
+        fleet advertises nothing to distinguish on for this method and
+        routing degenerates to plain rendezvous."""
+        with self._lock:
+            replicas = list(self.replicas)
+        eligible = {r.replica_id for r in replicas}
+        constrained = False
+        if "Aggregation" in method:
+            # the big compression prove wants a mesh or the biggest box
+            meshy = {r.replica_id for r in replicas
+                     if r.caps is not None and r.caps.mesh_shape}
+            mems = [(r.caps.memory_mb, r.replica_id) for r in replicas
+                    if r.caps is not None and r.caps.memory_mb is not None]
+            big = set()
+            if mems:
+                top = max(mb for mb, _ in mems)
+                big = {rid for mb, rid in mems if mb == top}
+            if meshy or big:
+                eligible &= meshy | big
+                constrained = True
+        k = self._method_k(method)
+        if k is not None:
+            # only replicas DECLARING a too-small max_k are ruled out;
+            # an undeclared max_k constrains nothing
+            small = {r.replica_id for r in replicas
+                     if r.caps is not None and r.caps.max_k is not None
+                     and r.caps.max_k < k}
+            if small:
+                eligible -= small
+                constrained = True
+        return eligible, constrained
+
     def _route(self, method: str, digest: str, excluded) -> Replica | None:
         """Rendezvous hashing: stable per-digest replica ranking with no
         shared routing state — the same witness always prefers the same
-        replica, and losing a replica only moves its own keys."""
+        replica, and losing a replica only moves its own keys. With
+        capability constraints the eligible set ranks first; dispatching
+        from the remainder is a visible fallback
+        (``dispatcher_placement_fallbacks``)."""
         ranked = sorted(self.replicas, key=lambda r: hashlib.sha256(
             f"{digest}|{r.replica_id}".encode()).hexdigest())
-        for replica in ranked:
-            rid = replica.replica_id
-            if rid in excluded or not replica.supports(method):
-                continue
-            try:
-                self._breakers[rid].admit()
-            except BreakerOpen:
-                self.health.incr("dispatcher_breaker_skips")
-                continue
-            if not self._healthy_cached(replica):
-                self.health.incr("dispatcher_replica_unhealthy")
-                continue
-            return replica
+        eligible, constrained = self._eligible(method)
+        if constrained:
+            tiers = [[r for r in ranked if r.replica_id in eligible],
+                     [r for r in ranked if r.replica_id not in eligible]]
+        else:
+            tiers = [ranked]
+        for tier_i, pool in enumerate(tiers):
+            for replica in pool:
+                rid = replica.replica_id
+                if rid in excluded or not replica.supports(method):
+                    continue
+                try:
+                    self._breakers[rid].admit()
+                except BreakerOpen:
+                    self.health.incr("dispatcher_breaker_skips")
+                    continue
+                if not self._healthy_cached(replica):
+                    self.health.incr("dispatcher_replica_unhealthy")
+                    continue
+                if tier_i == 1:
+                    self.health.incr("dispatcher_placement_fallbacks")
+                return replica
         return None
 
     # -- lease lifecycle ---------------------------------------------------
@@ -493,6 +853,7 @@ class Dispatcher:
 
     def dispatch(self, method: str, params: dict, heartbeat=None) -> dict:
         from .jobs import witness_digest
+        self.sweep_members()
         digest = witness_digest(method, params)
         with self._lock:
             excluded = set(self._excluded.get(digest, ()))
@@ -578,13 +939,19 @@ class Dispatcher:
     # -- introspection -----------------------------------------------------
 
     def snapshot(self) -> dict:
-        """Per-replica state for /healthz and the Prometheus gauges."""
+        """Per-replica state for /healthz and the Prometheus gauges —
+        including each member's capability record and announce-heartbeat
+        age (ISSUE 18). Snapshotting also runs the liveness sweep, so a
+        scraped-but-idle dispatcher still expires silent members."""
+        self.sweep_members()
+        now = self._clock()
         with self._lock:
             reps = []
             for r in self.replicas:
                 rid = r.replica_id
                 cached = self._health_cache.get(rid)
                 st = self._stats.get(rid, {"dispatched": 0, "failures": 0})
+                hb = self._heartbeats.get(rid)
                 reps.append({
                     "replica_id": rid,
                     "breaker": self._breakers[rid].snapshot(),
@@ -593,7 +960,16 @@ class Dispatcher:
                         1 for v in self._active.values() if v == rid),
                     "dispatched": st["dispatched"],
                     "failures": st["failures"],
+                    "dynamic": rid in self._dynamic,
+                    "capabilities": (None if r.caps is None
+                                     else r.caps.to_dict()),
+                    "url": None if r.caps is None else r.caps.url,
+                    "last_heartbeat_age_s": (None if hb is None
+                                             else round(now - hb, 3)),
                 })
             return {"replicas": reps, "lease_s": self.lease_s,
+                    "ttl_s": self.ttl_s,
+                    "members": len(self.replicas),
+                    "dynamic_members": len(self._dynamic),
                     "active_leases": len(self._active),
                     "excluded_digests": len(self._excluded)}

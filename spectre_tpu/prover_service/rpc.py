@@ -68,6 +68,11 @@ RPC_METHOD_STEP = "genEvmProof_SyncStepCompressed"
 RPC_METHOD_COMMITTEE = "genEvmProof_CommitteeUpdateCompressed"
 RPC_METHOD_STEP_SUBMIT = "submitProof_SyncStepCompressed"
 RPC_METHOD_COMMITTEE_SUBMIT = "submitProof_CommitteeUpdateCompressed"
+# aggregation cadence (ISSUE 18): one job per cadence window of sealed
+# periods — re-verifies the stored chain and emits the window tip's
+# EVM-verifiable aggregate for contract publication
+RPC_METHOD_AGG = "genEvmProof_AggregationCadence"
+RPC_METHOD_AGG_SUBMIT = "submitProof_AggregationCadence"
 
 # JSON-RPC 2.0 + implementation-defined codes (-32000..-32099 server errors)
 PARSE_ERROR = -32700
@@ -129,6 +134,39 @@ def run_proof_method(state, method: str, params: dict,
             "instances": [hex(v) for v in instances],
             "calldata": "0x" + encode_calldata(instances, proof).hex(),
             "committee_poseidon": hex(instances[pos_idx]),
+        }
+    if method == RPC_METHOD_AGG:
+        # aggregation cadence (ISSUE 18): the params carry the stored
+        # chain window [start_period, period]. The job re-checks every
+        # poseidon chain link, re-verifies the window tip's compressed
+        # proof on THIS host's keys (cross-host: the window may have
+        # been proved anywhere in the farm), and returns the tip's
+        # EVM-verifiable artifact as the publishable aggregate.
+        with phase("job/aggregate"):
+            chain = params["chain"]
+            assert chain, "empty aggregation window"
+            for prev, cur in zip(chain, chain[1:]):
+                assert int(cur["period"]) == int(prev["period"]) + 1, \
+                    f"aggregation window not contiguous at {cur['period']}"
+                assert cur.get("prev_poseidon") == prev.get(
+                    "committee_poseidon"), \
+                    f"chain link broken at period {cur['period']}"
+            if heartbeat is not None:
+                heartbeat()
+            tip = chain[-1]
+            if hasattr(state, "verify_proof"):
+                from .selfverify import decode_result
+                proof, instances = decode_result(tip)
+                assert state.verify_proof("committee", proof, instances), \
+                    "aggregation window tip proof failed verification"
+        return {
+            "proof": tip["proof"],
+            "instances": list(tip["instances"]),
+            "calldata": tip.get("calldata"),
+            "committee_poseidon": tip.get("committee_poseidon"),
+            "start_period": int(params["start_period"]),
+            "period": int(params["period"]),
+            "aggregated": len(chain),
         }
     raise ValueError(f"unprovable method {method}")
 
@@ -295,7 +333,8 @@ class _Handler(BaseHTTPRequestHandler):
         id_ = req.get("id")
         method = req["method"]
         params = req.get("params") or {}
-        if method in (RPC_METHOD_STEP, RPC_METHOD_COMMITTEE):
+        if method in (RPC_METHOD_STEP, RPC_METHOD_COMMITTEE,
+                      RPC_METHOD_AGG):
             # blocking reference semantics, implemented over the queue:
             # submit (dedup'd + journaled) then wait for the terminal state
             jid = self.jobs.submit(method, params)
@@ -305,9 +344,11 @@ class _Handler(BaseHTTPRequestHandler):
             if job.status == "cancelled":
                 return _error(JOB_FAILED, "job cancelled", id_)
             return _job_error(job, id_)
-        if method in (RPC_METHOD_STEP_SUBMIT, RPC_METHOD_COMMITTEE_SUBMIT):
+        if method in (RPC_METHOD_STEP_SUBMIT, RPC_METHOD_COMMITTEE_SUBMIT,
+                      RPC_METHOD_AGG_SUBMIT):
             blocking = {RPC_METHOD_STEP_SUBMIT: RPC_METHOD_STEP,
-                        RPC_METHOD_COMMITTEE_SUBMIT: RPC_METHOD_COMMITTEE}
+                        RPC_METHOD_COMMITTEE_SUBMIT: RPC_METHOD_COMMITTEE,
+                        RPC_METHOD_AGG_SUBMIT: RPC_METHOD_AGG}
             timeout = params.pop("timeout", None)
             # deadline propagation: the client's own deadline clamps the
             # per-job timeout — no worker burns on an unread result
@@ -420,6 +461,18 @@ class _Handler(BaseHTTPRequestHandler):
                 result["self_check"] = sc.snapshot()
             if self.dispatcher is not None:
                 result["dispatcher"] = self.dispatcher.snapshot()
+        elif method == "registerReplica":
+            # farm membership (ISSUE 18): replicas announce themselves
+            # (and heartbeat) here; the dispatcher journals joins and
+            # TTL-expires the silent
+            if self.dispatcher is None:
+                return _error(METHOD_NOT_FOUND,
+                              "not a dispatcher head (serve with a "
+                              "Dispatcher to accept replica announces)",
+                              id_)
+            result = self.dispatcher.register_remote(
+                params["replica_id"], url=params.get("url"),
+                capabilities=params.get("capabilities"))
         elif method == "ping":
             result = "pong"
         else:
@@ -427,10 +480,34 @@ class _Handler(BaseHTTPRequestHandler):
         return {"jsonrpc": "2.0", "result": result, "id": id_}
 
 
+def _announce_loop(stop: threading.Event, head_url: str, payload: dict,
+                   interval: float):
+    """Replica-side membership announce (ISSUE 18): POST
+    ``registerReplica`` to the dispatcher head — once immediately, then
+    every `interval` seconds as the liveness heartbeat. Failures are
+    tolerated and counted (``replica_announce_failures``); only a TTL
+    of silence deregisters the replica, and the next successful
+    announce re-joins it."""
+    from ..utils import faults
+    from .rpc_client import ProverClient
+    client = ProverClient(head_url, timeout=10.0)
+    while True:
+        try:
+            faults.check("replica.announce")
+            client._call("registerReplica", payload, timeout=10.0)
+            HEALTH.incr("replica_announces")
+        except Exception:
+            HEALTH.incr("replica_announce_failures")
+        if stop.wait(interval):
+            return
+
+
 def serve(state: ProverState, host: str = "127.0.0.1", port: int = 3000,
           background: bool = False, journal_dir: str | None = None,
           job_timeout: float | None = None, follower=None, dispatcher=None,
-          replica_id: str | None = None, gateway=None, **queue_kw):
+          replica_id: str | None = None, gateway=None, announce=None,
+          announce_interval: float | None = None,
+          advertise_url: str | None = None, capabilities=None, **queue_kw):
     """`journal_dir` defaults to the state's params_dir (when set) — pass
     explicitly to place the crash-safe job journal elsewhere; `job_timeout`
     is the default per-job deadline for async submissions. `follower`
@@ -442,7 +519,13 @@ def serve(state: ProverState, host: str = "127.0.0.1", port: int = 3000,
     in a farm: it is stamped into every RPC error's data. `gateway`
     (ISSUE 14) mounts the cacheable GET /v1/* read plane: pass a
     constructed Gateway, or True to build one over `follower`'s update
-    store. Extra `queue_kw` (queue_depth, mem_watermark_mb,
+    store. `announce` (ISSUE 18, default $SPECTRE_ANNOUNCE_URL) is a
+    dispatcher-head URL this server announces itself to — every
+    `announce_interval` seconds ($SPECTRE_ANNOUNCE_INTERVAL_S) it POSTs
+    ``registerReplica`` with its `capabilities` record (default: a
+    best-effort :func:`~.dispatcher.capability_record` for this host)
+    and `advertise_url` (default http://`host`:`port`, with the bound
+    port when port=0). Extra `queue_kw` (queue_depth, mem_watermark_mb,
     stall_timeout, ...) reach the JobQueue's admission/supervision
     layer."""
     _Handler.state = state
@@ -465,6 +548,30 @@ def serve(state: ProverState, host: str = "127.0.0.1", port: int = 3000,
         # stored updates do
         _Handler.jobs.add_live_provider(gateway.live_artifacts)
     server = ThreadingHTTPServer((host, port), _Handler)
+    announce = announce if announce is not None \
+        else (os.environ.get("SPECTRE_ANNOUNCE_URL") or None)
+    if announce:
+        from .dispatcher import (ANNOUNCE_DEFAULT_S, ANNOUNCE_ENV,
+                                 capability_record)
+        if announce_interval is None:
+            try:
+                announce_interval = float(
+                    os.environ.get(ANNOUNCE_ENV, ANNOUNCE_DEFAULT_S))
+            except ValueError:
+                announce_interval = ANNOUNCE_DEFAULT_S
+        bound_port = server.server_address[1]
+        own_url = advertise_url or f"http://{host}:{bound_port}"
+        rid = _Handler.replica_id or f"replica-{host}:{bound_port}"
+        caps = capabilities if capabilities is not None \
+            else capability_record(state, url=own_url)
+        stop = threading.Event()
+        threading.Thread(
+            target=_announce_loop,
+            args=(stop, announce,
+                  {"replica_id": rid, "url": own_url,
+                   "capabilities": caps}, announce_interval),
+            daemon=True, name="spectre-announce").start()
+        server._announce_stop = stop    # tests/shutdown hook
     if background:
         t = threading.Thread(target=server.serve_forever, daemon=True)
         t.start()

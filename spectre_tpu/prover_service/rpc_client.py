@@ -91,6 +91,7 @@ class ProverClient:
         self._etag_cache: "OrderedDict[str, tuple]" = OrderedDict()
         self.etag_cache_max = 256
         self.cache_304s = 0         # revalidated-not-modified responses
+        self.endpoint_refreshes = 0  # membership-driven rotations grown
 
     @property
     def url(self) -> str:
@@ -105,6 +106,41 @@ class ProverClient:
     def _rotate_url(self):
         if len(self.urls) > 1:
             self._url_index = (self._url_index + 1) % len(self.urls)
+
+    def _refresh_endpoints(self) -> bool:
+        """Membership-driven endpoint discovery (ISSUE 18): when the
+        conn-reset rotation has exhausted every configured URL, ask each
+        endpoint's `health` RPC for the dispatcher membership and adopt
+        replica URLs this client doesn't know yet — a fleet that grew or
+        moved since the client was configured keeps serving it. One-shot
+        direct POSTs (no retry recursion). Returns True when the
+        rotation grew, with the current endpoint pointed at the first
+        new URL."""
+        for base in list(self.urls):
+            self._id += 1
+            body = json.dumps({"jsonrpc": "2.0", "method": "health",
+                               "params": {}, "id": self._id}).encode()
+            req = urllib.request.Request(
+                base, data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=min(self.timeout, 10.0)) as resp:
+                    data = json.load(resp)
+            except Exception:
+                continue
+            replicas = ((data.get("result") or {}).get("dispatcher")
+                        or {}).get("replicas") or []
+            fresh = [r.get("url") for r in replicas
+                     if isinstance(r, dict) and r.get("url")
+                     and r["url"] not in self.urls]
+            if fresh:
+                first = len(self.urls)
+                self.urls.extend(dict.fromkeys(fresh))
+                self._url_index = first
+                self.endpoint_refreshes += 1
+                return True
+        return False
 
     def _raise_rpc_error(self, data: dict, headers=None):
         err = (data or {}).get("error") or {}
@@ -128,6 +164,7 @@ class ProverClient:
         body = json.dumps({"jsonrpc": "2.0", "method": method,
                            "params": params, "id": self._id}).encode()
         attempt = 0
+        refreshed = False
         while True:
             req = urllib.request.Request(
                 self.url, data=body,
@@ -148,13 +185,22 @@ class ProverClient:
                     self._raise_rpc_error(data, headers=exc.headers)
                 raise
             except Exception as exc:
-                if _is_conn_reset(exc) and attempt < self.conn_retries:
-                    # farm-aware retry (ISSUE 11): prefer a DIFFERENT
-                    # replica — the endpoint that reset us is the one
-                    # most likely mid-restart
-                    self._rotate_url()
-                    attempt += 1
-                    continue
+                if _is_conn_reset(exc):
+                    if attempt < self.conn_retries:
+                        # farm-aware retry (ISSUE 11): prefer a DIFFERENT
+                        # replica — the endpoint that reset us is the one
+                        # most likely mid-restart
+                        self._rotate_url()
+                        attempt += 1
+                        continue
+                    if not refreshed and self._refresh_endpoints():
+                        # rotation exhausted (ISSUE 18): refresh the
+                        # endpoint list from dispatcher membership once
+                        # before failing hard — the adopted URLs get
+                        # their own conn-retry budget
+                        refreshed = True
+                        attempt = 0
+                        continue
                 raise
         if "error" in data:
             self._raise_rpc_error(data)

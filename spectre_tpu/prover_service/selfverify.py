@@ -185,8 +185,12 @@ def verified_prove(state, kind: str, args, heartbeat=None, health=HEALTH):
 # -- cross-host verification (ISSUE 11: proof farm) -------------------------
 
 def proof_kind(method: str) -> str:
-    """Map an RPC prove method to its verifying-key kind."""
-    return "committee" if "Committee" in method else "step"
+    """Map an RPC prove method to its verifying-key kind. The
+    aggregation cadence (ISSUE 18) emits the window tip's committee
+    aggregate, so it verifies against the committee keys."""
+    if "Committee" in method or "Aggregation" in method:
+        return "committee"
+    return "step"
 
 
 def decode_result(result: dict) -> tuple[bytes, list[int]]:

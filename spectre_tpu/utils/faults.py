@@ -111,6 +111,18 @@ SITES = {
                            "serving falls back to the update store, "
                            "counted on gateway_pack_build_failures, "
                            "rebuilt on the next seal event)"),
+    "replica.register": ("prover_service/dispatcher.py",
+                         "dispatcher-side registerReplica admission "
+                         "(`raise`/`timeout`/`connreset` surface to the "
+                         "announcing replica as an RPC error; the fleet "
+                         "is unchanged and the replica re-announces next "
+                         "interval)"),
+    "replica.announce": ("prover_service/rpc.py",
+                         "replica-side announce-loop POST to the "
+                         "dispatcher head (tolerated: counted on "
+                         "replica_announce_failures, the replica keeps "
+                         "serving and retries next interval — only a "
+                         "TTL of silence deregisters it)"),
 }
 
 

@@ -792,6 +792,243 @@ class TestFarmRpc:
             ProverClient([])
 
 
+# -- dynamic membership (ISSUE 18) ------------------------------------------
+
+
+AGG_METHOD = "genEvmProof_AggregationCadence"
+
+
+class TestMembership:
+    def test_register_heartbeat_ttl_lifecycle(self):
+        """registerReplica joins the fleet with a capability record;
+        re-announces are heartbeats; a member silent past ttl_s is
+        demoted through its breaker and deregistered; a re-join keeps
+        the open breaker (readmission via the half-open trial)."""
+        clk = [0.0]
+        d = Dispatcher([], ttl_s=30.0, clock=lambda: clk[0], poll_s=0.005)
+        hb0 = HEALTH.get("dispatcher_heartbeats")
+        ttl0 = HEALTH.get("dispatcher_member_ttl_expired")
+        res = d.register_remote("dyn-1", url="http://127.0.0.1:1",
+                                capabilities={"device": "cpu",
+                                              "memory_mb": 1024,
+                                              "max_k": 17})
+        assert res == {"replica_id": "dyn-1", "ttl_s": 30.0, "members": 1}
+        row = d.snapshot()["replicas"][0]
+        assert row["dynamic"] is True
+        assert row["capabilities"]["device"] == "cpu"
+        assert row["capabilities"]["max_k"] == 17
+        assert row["url"] == "http://127.0.0.1:1"
+        assert row["last_heartbeat_age_s"] == 0.0
+        clk[0] = 20.0                         # heartbeat refreshes TTL
+        d.register_remote("dyn-1", url="http://127.0.0.1:1")
+        assert HEALTH.get("dispatcher_heartbeats") == hb0 + 1
+        clk[0] = 45.0                         # 25 s since announce: alive
+        assert d.sweep_members() == []
+        clk[0] = 51.0                         # 31 s: past the TTL
+        assert d.sweep_members() == ["dyn-1"]
+        assert d.snapshot()["members"] == 0
+        assert HEALTH.get("dispatcher_member_ttl_expired") == ttl0 + 1
+        assert d.breaker("dyn-1").state == "open"   # demoted, not dropped
+        # re-join: membership is back, the breaker history is NOT reset
+        d.register_remote("dyn-1", url="http://127.0.0.1:1")
+        snap = d.snapshot()
+        assert snap["members"] == 1 and snap["dynamic_members"] == 1
+        assert d.breaker("dyn-1").state == "open"
+
+    def test_member_journal_replay_and_compaction(self, tmp_path):
+        """A dispatcher restart reconstructs the fleet from
+        dispatcher.members.jsonl (last join/leave per id wins) and
+        compacts it to the replay fixpoint."""
+        d1 = Dispatcher([], journal_dir=str(tmp_path), ttl_s=30.0,
+                        poll_s=0.005)
+        d1.register_remote("m1", url="http://127.0.0.1:9001",
+                           capabilities={"max_k": 18,
+                                         "mesh_shape": [2, 4]})
+        d1.register_remote("m2", url="http://127.0.0.1:9002")
+        d1.deregister("m2", reason="drain")
+        rep0 = HEALTH.get("dispatcher_members_replayed")
+        d2 = Dispatcher([], journal_dir=str(tmp_path), ttl_s=30.0,
+                        poll_s=0.005)
+        snap = d2.snapshot()
+        assert [r["replica_id"] for r in snap["replicas"]] == ["m1"]
+        assert snap["replicas"][0]["dynamic"] is True
+        assert snap["replicas"][0]["capabilities"]["max_k"] == 18
+        assert snap["replicas"][0]["capabilities"]["mesh_shape"] == [2, 4]
+        assert HEALTH.get("dispatcher_members_replayed") == rep0 + 1
+        lines = [ln for ln in
+                 (tmp_path / "dispatcher.members.jsonl").read_text()
+                 .splitlines() if ln.strip()]
+        assert len(lines) == 1                # compacted to one join
+        assert json.loads(lines[0])["replica"] == "m1"
+
+    def test_static_id_never_shadowed_by_journal(self, tmp_path):
+        """A statically-registered replica keeps its in-process identity
+        even when the member journal remembers a same-named announce."""
+        d1 = Dispatcher([], journal_dir=str(tmp_path), poll_s=0.005)
+        d1.register_remote("a", url="http://127.0.0.1:9009")
+        calls = []
+        d2 = Dispatcher([LocalReplica("a", runner=_mk_runner(calls))],
+                        journal_dir=str(tmp_path), poll_s=0.005)
+        assert d2.dispatch(METHOD, {}) == _result()
+        assert len(calls) == 1                # the LOCAL replica proved
+
+    def test_register_fault_site_leaves_fleet_unchanged(self):
+        faults.arm("replica.register", "raise", 1)
+        d = Dispatcher([], poll_s=0.005)
+        with pytest.raises(faults.InjectedFault):
+            d.register_remote("x", url="http://127.0.0.1:1")
+        assert d.snapshot()["members"] == 0
+        d.register_remote("x", url="http://127.0.0.1:1")  # next announce
+        assert d.snapshot()["members"] == 1
+
+    def test_register_without_url_rejected(self):
+        d = Dispatcher([], poll_s=0.005)
+        with pytest.raises(ValueError, match="needs a url"):
+            d.register_remote("nourl")
+
+    def test_announce_loop_joins_fleet_over_http(self, tmp_path):
+        """Full announce wiring: serve(announce=...) spawns the
+        heartbeat loop, the dispatcher head admits the replica with its
+        capability record, /healthz lists capability + heartbeat age,
+        and /metrics grows the membership gauges."""
+        from spectre_tpu.observability.prom import render
+        from spectre_tpu.prover_service.rpc import serve
+        d = Dispatcher([], journal_dir=str(tmp_path), ttl_s=60.0,
+                       poll_s=0.005)
+        port = _free_port()
+        # the head announces itself to itself: one process exercises
+        # both sides of the registerReplica loop
+        server = serve(_ServeState(), host="127.0.0.1", port=port,
+                       background=True, journal_dir=str(tmp_path),
+                       dispatcher=d, replica_id="self-1",
+                       announce=f"http://127.0.0.1:{port}",
+                       announce_interval=0.05)
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and d.snapshot()["members"] == 0:
+                time.sleep(0.02)
+            snap = d.snapshot()
+            assert snap["members"] == 1 and snap["dynamic_members"] == 1
+            row = snap["replicas"][0]
+            assert row["replica_id"] == "self-1"
+            assert row["url"] == f"http://127.0.0.1:{port}"
+            assert row["capabilities"]["memory_mb"]   # sysconf-derived
+            assert row["last_heartbeat_age_s"] is not None
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5) as resp:
+                hz = json.load(resp)
+            reps = {x["replica_id"]: x
+                    for x in hz["dispatcher"]["replicas"]}
+            assert reps["self-1"]["capabilities"]["url"] == \
+                f"http://127.0.0.1:{port}"
+            assert reps["self-1"]["last_heartbeat_age_s"] is not None
+            metrics = render()
+            # the membership gauges are a union over every live
+            # Dispatcher (weakset registry), so other tests' uncollected
+            # dispatchers may inflate the counts — pin OUR replica's
+            # sample and a lower bound, not the global total
+            assert 'spectre_replica_heartbeat_age_s{replica="self-1"}' \
+                in metrics
+            dyn = [ln for ln in metrics.splitlines()
+                   if ln.startswith('spectre_dispatcher_members'
+                                    '{kind="dynamic"}')]
+            assert dyn and int(float(dyn[0].split()[-1])) >= 1
+        finally:
+            server._announce_stop.set()
+            server.shutdown()
+
+    def test_announce_failure_tolerated_and_retried(self, tmp_path):
+        """An injected announce failure is counted and absorbed — the
+        replica keeps serving and the NEXT heartbeat joins it."""
+        from spectre_tpu.prover_service.rpc import serve
+        faults.arm("replica.announce", "raise", 1)
+        d = Dispatcher([], journal_dir=str(tmp_path), ttl_s=60.0,
+                       poll_s=0.005)
+        port = _free_port()
+        af0 = HEALTH.get("replica_announce_failures")
+        server = serve(_ServeState(), host="127.0.0.1", port=port,
+                       background=True, journal_dir=str(tmp_path),
+                       dispatcher=d, replica_id="flaky-1",
+                       announce=f"http://127.0.0.1:{port}",
+                       announce_interval=0.05)
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and d.snapshot()["members"] == 0:
+                time.sleep(0.02)
+            assert d.snapshot()["members"] == 1
+            assert HEALTH.get("replica_announce_failures") == af0 + 1
+        finally:
+            server._announce_stop.set()
+            server.shutdown()
+
+
+# -- capability-aware placement (ISSUE 18) ----------------------------------
+
+
+class TestPlacement:
+    def test_aggregation_routes_to_mesh_or_big_memory(self):
+        """Aggregation proves land only on replicas advertising a mesh
+        or the largest declared memory — zero fallbacks while one is
+        healthy."""
+        calls = {r: [] for r in ("plain", "meshy", "big")}
+        caps = {"plain": {"memory_mb": 8192},
+                "meshy": {"mesh_shape": [2, 4], "memory_mb": 4096},
+                "big": {"memory_mb": 65536}}
+        d = Dispatcher([LocalReplica(r, runner=_mk_runner(calls[r]),
+                                     capabilities=caps[r])
+                        for r in calls], poll_s=0.005)
+        fb0 = HEALTH.get("dispatcher_placement_fallbacks")
+        for i in range(8):
+            assert d.dispatch(AGG_METHOD, {"w": i}) == _result()
+        assert calls["plain"] == []
+        assert len(calls["meshy"]) + len(calls["big"]) == 8
+        assert HEALTH.get("dispatcher_placement_fallbacks") == fb0
+
+    def test_max_k_placement(self):
+        """k-sized work skips replicas DECLARING a too-small max_k even
+        when rendezvous ranks them first."""
+        calls = {"tiny": [], "big": []}
+        d = Dispatcher([
+            LocalReplica("tiny", runner=_mk_runner(calls["tiny"]),
+                         capabilities={"max_k": 14}),
+            LocalReplica("big", runner=_mk_runner(calls["big"]),
+                         capabilities={"max_k": 22})],
+            poll_s=0.005, method_k={METHOD: 20})
+        params = next({"w": i} for i in range(64)
+                      if _ranked_ids(["tiny", "big"],
+                                     params={"w": i})[0] == "tiny")
+        assert d.dispatch(METHOD, params) == _result()
+        assert calls["tiny"] == [] and len(calls["big"]) == 1
+
+    def test_undeclared_capabilities_constrain_nothing(self):
+        """A capability-less fleet routes exactly like before — plain
+        rendezvous, no fallback accounting."""
+        calls = {"a": [], "b": []}
+        d = Dispatcher([LocalReplica(r, runner=_mk_runner(calls[r]))
+                        for r in calls], poll_s=0.005)
+        fb0 = HEALTH.get("dispatcher_placement_fallbacks")
+        assert d.dispatch(AGG_METHOD, {"w": 3}) == _result()
+        first = _ranked_ids(list(calls), method=AGG_METHOD,
+                            params={"w": 3})[0]
+        assert len(calls[first]) == 1
+        assert HEALTH.get("dispatcher_placement_fallbacks") == fb0
+
+    def test_fallback_counter_when_no_capable_replica_healthy(self):
+        """With every eligible replica behind an open breaker, work
+        still lands — on the ranked remainder, visibly counted."""
+        calls = {"meshy": [], "plain": []}
+        d = Dispatcher([
+            LocalReplica("meshy", runner=_mk_runner(calls["meshy"]),
+                         capabilities={"mesh_shape": [2, 2]}),
+            LocalReplica("plain", runner=_mk_runner(calls["plain"]))],
+            poll_s=0.005, breaker_threshold=1, breaker_cooldown=60.0)
+        d.breaker("meshy").record(False)      # threshold 1 -> open
+        fb0 = HEALTH.get("dispatcher_placement_fallbacks")
+        assert d.dispatch(AGG_METHOD, {}) == _result()
+        assert calls["meshy"] == [] and len(calls["plain"]) == 1
+        assert HEALTH.get("dispatcher_placement_fallbacks") == fb0 + 1
+
+
 # -- hygiene pins -----------------------------------------------------------
 
 
@@ -826,5 +1063,6 @@ class TestFarmHygiene:
             assert json.load(fh) == {"suppressions": []}
 
     def test_fault_sites_documented(self):
-        for site in ("replica.dispatch", "replica.health", "replica.lease"):
+        for site in ("replica.dispatch", "replica.health", "replica.lease",
+                     "replica.register", "replica.announce"):
             assert site in faults.SITES

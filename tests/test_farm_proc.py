@@ -1,0 +1,325 @@
+"""Real-process proof-farm drills (ISSUE 18).
+
+Everything in test_farm.py runs the farm in ONE process — fast and
+deterministic, but a thread can never die the way a box does. This tier
+launches actual ``serve()`` subprocesses (each pays a real jax import,
+hence the dedicated `make test-farm-proc` budget) and kills them with
+SIGKILL:
+
+* three replica processes announce themselves to an in-test dispatcher
+  head over HTTP, one is SIGKILLed mid-prove -> exactly one lease
+  takeover, a byte-identical final proof from a survivor, and TTL
+  deregistration of the corpse (journaled as a ``leave``);
+* a dispatcher-head PROCESS is SIGKILLed while its replica holds a
+  lease -> a fresh in-test Dispatcher + JobQueue over the same journal
+  directory replays the open lease as an exclusion, re-grants as a
+  takeover, finishes the SAME job id, and the witness-digest dedup
+  refuses to prove it twice.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from spectre_tpu import spec as SP
+from spectre_tpu.models import CommitteeUpdateCircuit
+from spectre_tpu.prover_service.dispatcher import (LEASE_JOURNAL_NAME,
+                                                   MEMBER_JOURNAL_NAME,
+                                                   Dispatcher, LocalReplica)
+from spectre_tpu.prover_service.jobs import JobQueue
+from spectre_tpu.prover_service.rpc import (RPC_METHOD_COMMITTEE,
+                                            RPC_METHOD_COMMITTEE_SUBMIT,
+                                            run_proof_method, serve)
+from spectre_tpu.prover_service.rpc_client import ProverClient
+from spectre_tpu.utils import faults
+from spectre_tpu.utils.health import HEALTH
+
+from test_follower import TINY, _mk_committee_update
+
+# `slow`: each drill pays real subprocess jax imports, and the tier-1
+# window is already budget-bound — these run via `make test-farm-proc`
+# (wired into `make test`) under their own wall-clock cap instead.
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        os.name != "posix", reason="needs POSIX subprocesses + SIGKILL"),
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _counter(name: str) -> int:
+    return HEALTH.snapshot()["counters"].get(name, 0)
+
+
+def _wait(predicate, timeout_s: float, what: str, poll_s: float = 0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(poll_s)
+    raise AssertionError(f"timed out after {timeout_s}s waiting for {what}")
+
+
+def _subprocess_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"            # subprocesses mirror the tier
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), env.get("PYTHONPATH")) if p)
+    return env
+
+
+def _reap(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+class _CannedCommitteeState:
+    """In-test twin of the subprocess replica state: same proof bytes,
+    same real get_instances — the byte-identity reference."""
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.concurrency = 1
+
+    def prove_committee(self, args):
+        return (b"\x02" * 64,
+                CommitteeUpdateCircuit.get_instances(args, self.spec))
+
+
+class _HeadState:
+    """The dispatcher head proves nothing itself — the queue's runner is
+    the Dispatcher."""
+
+    concurrency = 2
+
+
+# argv: head_url replica_id prove_sleep_s journal_dir
+REPLICA_SCRIPT = r"""
+import sys, time
+
+head_url, rid, sleep_s, jdir = sys.argv[1:5]
+
+from spectre_tpu import spec as SP
+from spectre_tpu.models import CommitteeUpdateCircuit
+from spectre_tpu.prover_service.rpc import serve
+
+
+class CannedState:
+    def __init__(self):
+        self.spec = SP.TINY
+        self.concurrency = 1
+
+    def prove_committee(self, args):
+        deadline = time.monotonic() + float(sleep_s)
+        while time.monotonic() < deadline:   # SIGKILL-able mid-prove
+            time.sleep(0.05)
+        return b"\x02" * 64, CommitteeUpdateCircuit.get_instances(
+            args, self.spec)
+
+
+serve(CannedState(), host="127.0.0.1", port=0, journal_dir=jdir,
+      replica_id=rid, announce=head_url, announce_interval=0.25)
+"""
+
+# argv: journal_dir prove_sleep_s
+HEAD_SCRIPT = r"""
+import sys, time
+
+jdir, sleep_s = sys.argv[1:3]
+
+from spectre_tpu.prover_service.dispatcher import Dispatcher, LocalReplica
+from spectre_tpu.prover_service.rpc import serve
+
+
+def slow_runner(method, params, heartbeat=None):
+    deadline = time.monotonic() + float(sleep_s)
+    while time.monotonic() < deadline:       # SIGKILL-able mid-prove
+        time.sleep(0.05)
+    return {"proof": "0x" + "ab" * 64, "instances": ["0x1"]}
+
+
+class HeadState:
+    concurrency = 1
+
+
+d = Dispatcher([LocalReplica("local-A", runner=slow_runner)],
+               journal_dir=jdir, lease_s=30.0)
+server = serve(HeadState(), host="127.0.0.1", port=0, background=True,
+               journal_dir=jdir, dispatcher=d)
+print(server.server_address[1], flush=True)
+while True:
+    time.sleep(1.0)
+"""
+
+STARTUP_S = 180.0           # three parallel jax imports on a cold cache
+PROVE_SLEEP_S = 5.0
+
+
+class TestRealProcessFailover:
+    def test_sigkill_replica_mid_prove_takeover_byte_identical(
+            self, tmp_path):
+        """ISSUE 18 acceptance: >=3 real serve() processes, SIGKILL the
+        lease holder mid-prove -> exactly one dispatcher_lease_takeovers
+        increment, a byte-identical final proof, and the corpse
+        deregistered by TTL with a journaled `leave`."""
+        head_dir = tmp_path / "head"
+        head_dir.mkdir()
+        d = Dispatcher(replicas=[], journal_dir=str(head_dir),
+                       lease_s=30.0, ttl_s=3.0, poll_s=0.05,
+                       health_ttl_s=0.2)
+        head_state = _HeadState()
+        server = serve(head_state, host="127.0.0.1", port=0,
+                       background=True, journal_dir=str(head_dir),
+                       dispatcher=d)
+        head_url = f"http://127.0.0.1:{server.server_address[1]}"
+        procs: dict[str, subprocess.Popen] = {}
+        try:
+            for i in range(3):
+                rid = f"proc-{i}"
+                rdir = tmp_path / rid
+                rdir.mkdir()
+                procs[rid] = subprocess.Popen(
+                    [sys.executable, "-c", REPLICA_SCRIPT, head_url, rid,
+                     str(PROVE_SLEEP_S), str(rdir)],
+                    env=_subprocess_env(), stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL)
+
+            def _members():
+                return {r["replica_id"]
+                        for r in d.snapshot()["replicas"] if r["dynamic"]}
+
+            _wait(lambda: _members() == set(procs), STARTUP_S,
+                  "all three replicas to announce")
+            for row in d.snapshot()["replicas"]:
+                assert row["capabilities"]["url"].startswith("http://")
+
+            update = _mk_committee_update(TINY, 1)
+            params = {"light_client_update": update}
+            expected = run_proof_method(_CannedCommitteeState(TINY),
+                                        RPC_METHOD_COMMITTEE, params)
+
+            takeovers = _counter("dispatcher_lease_takeovers")
+            client = ProverClient(head_url, timeout=120.0)
+            jid = client._call(RPC_METHOD_COMMITTEE_SUBMIT,
+                               params)["job_id"]
+
+            def _lease_holder():
+                for row in d.snapshot()["replicas"]:
+                    if row["active_leases"]:
+                        return row["replica_id"]
+                return None
+
+            _wait(lambda: _lease_holder() is not None, 60.0,
+                  "a lease grant")
+            victim = _lease_holder()
+            time.sleep(1.0)          # the canned prove is mid-sleep now
+            os.kill(procs[victim].pid, signal.SIGKILL)
+            procs[victim].wait(timeout=10)
+
+            _wait(lambda: client.proof_status(jid)["status"] == "done",
+                  120.0, "the takeover replica to finish")
+            result = client.proof_result(jid)
+            # byte-identical completion on a DIFFERENT box
+            for key in ("proof", "instances", "calldata",
+                        "committee_poseidon"):
+                assert result[key] == expected[key]
+            assert _counter("dispatcher_lease_takeovers") == takeovers + 1
+
+            # TTL liveness: the corpse stops heartbeating and is
+            # deregistered, survivors stay
+            _wait(lambda: victim not in _members(), 30.0,
+                  "TTL deregistration of the killed replica")
+            assert _members() == set(procs) - {victim}
+            journal = (head_dir / MEMBER_JOURNAL_NAME).read_text()
+            assert any(json.loads(ln).get("event") == "leave"
+                       and json.loads(ln)["replica"] == victim
+                       for ln in journal.splitlines() if ln.strip())
+        finally:
+            _reap(list(procs.values()))
+            server.shutdown()
+            head_state.jobs.stop()
+
+    def test_sigkill_head_process_lease_replay_and_dedup(self, tmp_path):
+        """Lease-journal replay across a PROCESS boundary: SIGKILL a
+        dispatcher head (taking its in-process lease holder with it),
+        rebuild Dispatcher + JobQueue over the same journals -> the open
+        lease replays as an exclusion, the takeover re-grant finishes
+        the SAME job id, and the witness-digest dedup refuses a second
+        prove."""
+        jdir = tmp_path / "head"
+        jdir.mkdir()
+        proc = subprocess.Popen(
+            [sys.executable, "-c", HEAD_SCRIPT, str(jdir),
+             str(PROVE_SLEEP_S)],
+            env=_subprocess_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True)
+        jid = None
+        try:
+            port_line = proc.stdout.readline().strip()
+            assert port_line, "head subprocess never printed its port"
+            client = ProverClient(f"http://127.0.0.1:{port_line}",
+                                  timeout=60.0)
+            params = {"light_client_update": {"window": 7}}
+            jid = client._call(RPC_METHOD_COMMITTEE_SUBMIT,
+                               params)["job_id"]
+
+            lease_path = jdir / LEASE_JOURNAL_NAME
+            _wait(lambda: lease_path.exists()
+                  and '"event": "lease"' in lease_path.read_text(),
+                  90.0, "the lease grant to hit the journal")
+            time.sleep(0.5)          # local-A is mid-sleep in its prove
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+        replayed = _counter("dispatcher_leases_replayed")
+        takeovers = _counter("dispatcher_lease_takeovers")
+        calls = {"n": 0}
+
+        def runner2(method, params, heartbeat=None):
+            calls["n"] += 1
+            return {"proof": "0x" + "ab" * 64, "instances": ["0x1"]}
+
+        d2 = Dispatcher([LocalReplica("local-B", runner=runner2)],
+                        journal_dir=str(jdir), lease_s=30.0)
+        assert _counter("dispatcher_leases_replayed") == replayed + 1
+        jobs2 = JobQueue(d2, concurrency=1, journal_dir=str(jdir),
+                         stall_timeout=600.0)
+        try:
+            # replay requeued the running job under its ORIGINAL id and
+            # the survivor finished it as a takeover
+            _wait(lambda: jobs2.status(jid)["status"] == "done", 60.0,
+                  "the replayed job to finish on the survivor")
+            assert jobs2.result(jid).result["proof"] == "0x" + "ab" * 64
+            assert calls["n"] == 1
+            assert _counter("dispatcher_lease_takeovers") == takeovers + 1
+
+            # witness-digest dedup across the process boundary: the same
+            # (method, params) maps back to the finished job, no re-prove
+            assert jobs2.submit(RPC_METHOD_COMMITTEE,
+                                {"light_client_update": {"window": 7}}) \
+                == jid
+            assert calls["n"] == 1
+        finally:
+            jobs2.stop()

@@ -698,6 +698,278 @@ class TestTracker:
         assert all(i.key()[0] == "committee" for i in items)
 
 
+# -- aggregation cadence (ISSUE 18) ------------------------------------------
+
+@pytest.fixture(scope="module")
+def evm_agg_setup():
+    """A real tiny-circuit proof + its generated Solidity verifier (the
+    test_evm.py recipe): the canned committee prover serves THIS proof,
+    so the published aggregate's bytes genuinely verify on-EVM."""
+    from test_plonk import _tiny_circuit
+
+    from spectre_tpu.evm import gen_evm_verifier
+    from spectre_tpu.plonk.constraint_system import (Assignment,
+                                                     CircuitConfig)
+    from spectre_tpu.plonk.keygen import keygen
+    from spectre_tpu.plonk.prover import prove
+    from spectre_tpu.plonk.srs import SRS
+    from spectre_tpu.plonk.transcript import KeccakTranscript
+
+    srs = SRS.unsafe_setup(7)
+    cfg = CircuitConfig(k=7, num_advice=1, num_lookup_advice=1,
+                        num_fixed=1, lookup_bits=4)
+    advice, lookup, fixed, selectors, copies, out = _tiny_circuit(cfg)
+    pk = keygen(srs, cfg, fixed, selectors, copies)
+    asg = Assignment(cfg, advice, lookup, fixed, selectors, [[out]], copies)
+    proof = prove(pk, srs, asg, transcript=KeccakTranscript())
+    src = gen_evm_verifier(pk.vk, srs, num_instances=1)
+    return out, proof, src
+
+
+class _EvmAggState(_FollowerState):
+    """Canned prover whose committee proofs are a REAL plonk proof of
+    the tiny circuit — every stored period carries EVM-verifiable bytes
+    (the poseidon chain still links: one circuit, one instance)."""
+
+    def __init__(self, spec, proof: bytes, out: int):
+        super().__init__(spec)
+        self._proof, self._out = proof, out
+
+    def prove_committee(self, args):
+        faults.check("backend.prove")
+        self.calls += 1
+        return self._proof, [self._out]
+
+
+class _CountingVerifier:
+    """Delegating verifier wrapper: pins that the EVM simulator really
+    ran once per publish (not short-circuited by a mock)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.calls = 0
+
+    def verify(self, instances, proof) -> bool:
+        self.calls += 1
+        return self.inner.verify(instances, proof)
+
+
+class TestAggregationCadence:
+    def test_cadence_publishes_evm_verified_windows(self, tmp_path,
+                                                    evm_agg_setup):
+        """ISSUE 18 acceptance: a follower driven across 2x the cadence
+        (5 periods, cadence 2) submits the aggregation circuit over the
+        stored chain at each sealed boundary and publishes through the
+        contract surface gated by the GENERATED Solidity verifier in
+        evm.simulator — calldata included."""
+        from spectre_tpu.contracts.spectre import (EvmProofVerifier,
+                                                   SpectreContract)
+        from spectre_tpu.evm.simulator import run_verifier
+        from spectre_tpu.follower.scheduler import AggregationPublisher
+        from spectre_tpu.prover_service.calldata import decode_calldata
+
+        out, proof, src = evm_agg_setup
+        verifier = _CountingVerifier(EvmProofVerifier(src))
+        contract = SpectreContract(TINY, 0, 0, agg_verifier=verifier)
+        state = _EvmAggState(TINY, proof, out)
+        jobs = _mk_queue(state, tmp_path)
+        beacon = FakeBeacon(TINY, fin_slot=80)
+        windows_before = _counter("follower_cadence_windows")
+        published_before = _counter("follower_aggregations_published")
+        fol = Follower(TINY, beacon, jobs, directory=str(tmp_path),
+                       cadence_periods=2,
+                       publisher=AggregationPublisher(contract))
+        try:
+            assert fol.snapshot()["agg_cadence_periods"] == 2
+            for fin_slot in (80, 144, 208, 272, 336):    # periods 1..5
+                beacon.advance(fin_slot)
+                period = TINY.sync_period(fin_slot)
+                _drive(fol, lambda: fol.store.has_committee(period))
+            # boundaries seal strictly below the tip: p=2 and p=4
+            _drive(fol, lambda: fol.store.has_aggregate(2)
+                   and fol.store.has_aggregate(4))
+            assert fol.store.latest_aggregate_period() == 4
+            assert not fol.store.has_aggregate(5)        # tip not sealed
+            assert sorted(contract.aggregated_ranges) == [2, 4]
+            assert verifier.calls == 2                   # EVM sim ran twice
+            for end, start in ((2, 1), (4, 3)):
+                pub = contract.aggregated_ranges[end]
+                assert pub["start_period"] == start
+                # the published calldata decodes to exactly the
+                # instances + proof the simulator accepted
+                blob = bytes.fromhex(pub["calldata"].removeprefix("0x"))
+                inst, prf = decode_calldata(blob, 1)
+                assert inst == [out] and prf == proof
+                rec = fol.store.get_aggregate(end)
+                assert rec["start_period"] == start
+                assert rec["result"]["committee_poseidon"] == hex(out)
+                assert rec["result"]["aggregated"] == 2
+                assert rec["job_id"]
+            # acceptance, stated literally: the published calldata
+            # verifies in evm.simulator
+            inst, prf = decode_calldata(bytes.fromhex(
+                contract.aggregated_ranges[4]["calldata"]
+                .removeprefix("0x")), 1)
+            assert run_verifier(src, inst, prf)
+            assert _counter("follower_cadence_windows") == \
+                windows_before + 2
+            assert _counter("follower_aggregations_published") == \
+                published_before + 2
+            assert fol.store.snapshot()["latest_aggregate_period"] == 4
+        finally:
+            jobs.stop()
+
+    def test_cadence_restart_rederives_only_unpublished_windows(
+            self, tmp_path):
+        """has_aggregate() is the dedup key and it SURVIVES restart: a
+        follower rebuilt over the same journal never re-submits (or
+        re-publishes) a window that already landed."""
+        from spectre_tpu.contracts.spectre import SpectreContract
+        from spectre_tpu.follower.scheduler import AggregationPublisher
+
+        beacon = FakeBeacon(TINY, fin_slot=80)
+
+        state_a = _FollowerState(TINY)
+        jobs_a = _mk_queue(state_a, tmp_path)
+        contract_a = SpectreContract(TINY, 0, 0)
+        fol_a = Follower(TINY, beacon, jobs_a, directory=str(tmp_path),
+                         cadence_periods=2,
+                         publisher=AggregationPublisher(contract_a))
+        for fin_slot in (80, 144, 208):                  # periods 1..3
+            beacon.advance(fin_slot)
+            period = TINY.sync_period(fin_slot)
+            _drive(fol_a, lambda: fol_a.store.has_committee(period))
+        _drive(fol_a, lambda: fol_a.store.has_aggregate(2))
+        assert sorted(contract_a.aggregated_ranges) == [2]
+        jobs_a.stop()
+
+        # replayed store already knows window 2 is done
+        store_b = UpdateStore(str(tmp_path))
+        assert store_b.has_aggregate(2)
+        assert store_b.latest_aggregate_period() == 2
+
+        windows_before = _counter("follower_cadence_windows")
+        state_b = _FollowerState(TINY)
+        jobs_b = _mk_queue(state_b, tmp_path)
+        contract_b = SpectreContract(TINY, 0, 0)
+        fol_b = Follower(TINY, beacon, jobs_b, store=store_b,
+                         cadence_periods=2,
+                         publisher=AggregationPublisher(contract_b))
+        try:
+            for fin_slot in (272, 336):                  # periods 4, 5
+                beacon.advance(fin_slot)
+                period = TINY.sync_period(fin_slot)
+                _drive(fol_b, lambda: fol_b.store.has_committee(period))
+            _drive(fol_b, lambda: fol_b.store.has_aggregate(4))
+            # only the NEW window was derived; window 2 never re-ran
+            assert _counter("follower_cadence_windows") == \
+                windows_before + 1
+            assert sorted(contract_b.aggregated_ranges) == [4]
+        finally:
+            jobs_b.stop()
+
+    def test_publish_failure_keeps_job_and_retries(self, tmp_path):
+        """A publish rejection (simulator refusal, transport break) must
+        not lose the finished proof: the job is kept, the failure
+        counted, and the SAME job re-publishes after the backoff — no
+        re-prove, no resubmission."""
+        from spectre_tpu.follower.scheduler import AggregationPublisher
+
+        clk = {"t": 0.0}
+        store = UpdateStore(str(tmp_path))
+        for p, pos in ((1, "0xa"), (2, "0xb"), (3, "0xc")):
+            store.append_committee(p, {"committee_poseidon": pos,
+                                       "proof": "0x" + "02" * 64,
+                                       "instances": [pos]})
+
+        class FlakyContract:
+            def __init__(self):
+                self.fails = 1
+                self.published = []
+
+            def publish_aggregate(self, **kw):
+                if self.fails:
+                    self.fails -= 1
+                    raise AssertionError("simulator rejected calldata")
+                self.published.append(kw)
+                return kw
+
+        contract = FlakyContract()
+        jobs = ScriptedJobs()
+        sched = ProofScheduler(jobs, store, clock=lambda: clk["t"],
+                               cadence_periods=2,
+                               publisher=AggregationPublisher(contract))
+        sched.pump()                        # derives [1,2] -> submits j1
+        assert jobs._n == 1
+        jobs.finish("j1", {"proof": "0x" + "02" * 64, "instances": ["0xb"],
+                           "committee_poseidon": "0xb",
+                           "start_period": 1, "period": 2})
+        before = _counter("follower_publish_failures")
+        sched.pump()                        # publish refused
+        assert _counter("follower_publish_failures") == before + 1
+        assert not store.has_aggregate(2)   # never journaled unpublished
+        assert not contract.published
+        sched.pump()                        # inside the backoff window
+        assert not contract.published
+        clk["t"] = 2.0                      # past the 1 s backoff
+        sched.pump()
+        assert store.has_aggregate(2)
+        assert len(contract.published) == 1
+        assert contract.published[0]["period"] == 2
+        assert jobs._n == 1                 # same job: no re-prove
+        assert sched.backlog == 0
+
+    def test_cadence_window_hole_skipped_until_chain_heals(self, tmp_path):
+        """A quarantined mid-window record makes the window underfull:
+        it is counted (follower_cadence_holes), skipped this cycle, and
+        re-derived once the chain heals — never submitted with a gap."""
+        store = UpdateStore(str(tmp_path))
+        for p, pos in ((1, "0xa"), (2, "0xb"), (3, "0xc")):
+            store.append_committee(p, {"committee_poseidon": pos})
+        jobs = ScriptedJobs()
+        sched = ProofScheduler(jobs, store, clock=lambda: 0.0,
+                               cadence_periods=2)
+        holes_before = _counter("follower_cadence_holes")
+        faults.install_plan("artifact.read:corrupt:1")
+        sched.pump()                        # window read hits the rot
+        assert _counter("follower_cadence_holes") == holes_before + 1
+        assert jobs._n == 0                 # nothing submitted with a gap
+        store.append_committee(1, {"committee_poseidon": "0xa"})  # heal
+        sched.pump()
+        assert jobs._n == 1                 # window re-derived intact
+
+    def test_agg_method_rejects_broken_chain(self):
+        """The aggregation circuit re-checks every poseidon link: a
+        tampered window is refused as witness-rejected (AssertionError
+        -> -32000), which the dispatcher never fails over."""
+        from spectre_tpu.prover_service.rpc import RPC_METHOD_AGG
+
+        state = _FollowerState(TINY)
+        good = [{"period": 1, "prev_poseidon": None,
+                 "committee_poseidon": "0xa", "proof": "0x01",
+                 "instances": ["0x1"]},
+                {"period": 2, "prev_poseidon": "0xa",
+                 "committee_poseidon": "0xb", "proof": "0x02",
+                 "instances": ["0x2"]}]
+        res = run_proof_method(state, RPC_METHOD_AGG,
+                               {"start_period": 1, "period": 2,
+                                "chain": good})
+        assert res["aggregated"] == 2
+        assert res["committee_poseidon"] == "0xb"
+        assert state.calls == 0             # aggregation never re-proves
+
+        broken = [dict(good[0]), dict(good[1], prev_poseidon="0xbad")]
+        with pytest.raises(AssertionError, match="chain link broken"):
+            run_proof_method(state, RPC_METHOD_AGG,
+                             {"start_period": 1, "period": 2,
+                              "chain": broken})
+        gap = [dict(good[0]), dict(good[1], period=3)]
+        with pytest.raises(AssertionError, match="not contiguous"):
+            run_proof_method(state, RPC_METHOD_AGG,
+                             {"start_period": 1, "period": 3,
+                              "chain": gap})
+
+
 def _rpc_post(port, payload, timeout=60):
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/rpc", data=json.dumps(payload).encode(),

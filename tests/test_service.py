@@ -533,7 +533,79 @@ class TestProverClient:
         monkeypatch.setattr(rc.urllib.request, "urlopen", always_reset)
         with pytest.raises(ConnectionResetError):
             client.ping()
-        assert len(calls) == 2
+        # two prove attempts, then ONE membership probe (ISSUE 18: the
+        # exhausted rotation asks `health` for fresh replica URLs before
+        # failing hard; here it resets too, so the original error wins)
+        assert len(calls) == 3
+
+    def test_refreshes_endpoints_from_membership_when_exhausted(
+            self, monkeypatch):
+        """ISSUE-18 satellite: once the conn-reset rotation has burned
+        every configured URL, the client asks the dispatcher membership
+        (`health` RPC) for replica URLs it doesn't know yet and retries
+        against the adopted fleet before failing hard."""
+        from spectre_tpu.prover_service import rpc_client as rc
+        calls = []
+        fresh = "http://127.0.0.1:7103"
+
+        class _Resp:
+            def __init__(self, payload):
+                self._payload = payload
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def read(self):
+                return json.dumps(self._payload).encode()
+
+        def fake(req, timeout=None):
+            url, body = req.full_url, json.loads(req.data)
+            calls.append((url, body["method"]))
+            if body["method"] == "health":
+                if url == fresh:
+                    raise ConnectionResetError("still dead")
+                return _Resp({"jsonrpc": "2.0", "id": 1, "result": {
+                    "dispatcher": {"replicas": [
+                        {"replica_id": "r-new", "url": fresh},
+                        {"replica_id": "r-old",
+                         "url": "http://127.0.0.1:7101"}]}}})
+            if url == fresh:
+                return _Resp({"jsonrpc": "2.0", "result": "pong", "id": 1})
+            raise ConnectionResetError("injected reset")
+
+        monkeypatch.setattr(rc.urllib.request, "urlopen", fake)
+        client = rc.ProverClient(["http://127.0.0.1:7101",
+                                  "http://127.0.0.1:7102"],
+                                 timeout=5, conn_retries=1,
+                                 sleep=lambda s: None)
+        assert client.ping() == "pong"
+        assert client.endpoint_refreshes == 1
+        assert client.urls[-1] == fresh       # adopted, not replaced
+        assert client.url == fresh            # and now current
+        # the already-known url in the snapshot was NOT duplicated
+        assert client.urls.count("http://127.0.0.1:7101") == 1
+        # ping: reset on 7101, rotate-reset on 7102, health probe, retry
+        assert [m for _, m in calls].count("health") == 1
+
+    def test_refresh_failure_still_raises(self, monkeypatch):
+        """When no endpoint serves a membership snapshot the original
+        conn-reset surfaces unchanged — no infinite refresh loop."""
+        from spectre_tpu.prover_service import rpc_client as rc
+
+        def always_reset(req, timeout=None):
+            raise ConnectionResetError("injected reset")
+
+        monkeypatch.setattr(rc.urllib.request, "urlopen", always_reset)
+        client = rc.ProverClient(["http://127.0.0.1:7101",
+                                  "http://127.0.0.1:7102"],
+                                 timeout=5, conn_retries=1,
+                                 sleep=lambda s: None)
+        with pytest.raises(ConnectionResetError):
+            client.ping()
+        assert client.endpoint_refreshes == 0
 
     def test_get_update_cached_honors_304(self, tmp_path):
         """ISSUE-14 satellite: the client-side digest cache sends
