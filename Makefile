@@ -1,6 +1,6 @@
 # Build orchestration (reference parity: `justfile` recipes).
 
-.PHONY: all native test test-slow test-faults test-farm test-farm-proc test-gateway fixtures bench bench-fast bench-multichip bench-serve setup-committee setup-step lint lint-fast lint-deep tpu-evidence report-ci
+.PHONY: all native test test-slow test-faults test-farm test-farm-proc test-gateway fixtures bench bench-fast bench-multichip bench-serve bench-quotient bench-quotient-multichip setup-committee setup-step lint lint-fast lint-deep tpu-evidence report-ci
 
 all: native
 
@@ -92,6 +92,20 @@ bench-fast: native
 # Knobs: SPECTRE_BENCH_DEVICES (8), SPECTRE_MESH_SHAPE, BENCH_MULTICHIP_K.
 bench-multichip: native
 	BENCH_METRIC=multichip python bench.py --fast
+
+# quotient tier (ISSUE 19): the quotient phase timed with PRODUCTION
+# inputs (a real prove runs with the host quotient hooked), every timed
+# run byte-checked against the host h coefficients. bench-quotient gates
+# k=11 single-device against bench_floor.json (and rides `make bench-fast`
+# via BENCH_METRIC=all); the multichip variant runs the k=13 quotient
+# SHARDED on 8 simulated devices — any quotient_sharded_degraded tick is
+# a hard error. Knobs: BENCH_QUOTIENT_K(S), BENCH_QUOTIENT_TIMEOUT,
+# SPECTRE_BENCH_DEVICES (8), SPECTRE_MESH_SHAPE.
+bench-quotient: native
+	BENCH_METRIC=quotient python bench.py --fast
+
+bench-quotient-multichip: native
+	BENCH_METRIC=quotient_multichip python bench.py --fast
 
 # gateway read-plane tier (PR 14): 10^4-client in-process Zipf drill over
 # a synthetic sealed store — requests/s gated against bench_floor.json,

@@ -400,6 +400,111 @@ def ntt_device_phase(out_path: str):
                        "backend": jax.default_backend()}, f)
 
 
+def quotient_device_phase(out_path: str):
+    """Child process: time the quotient phase (`compute_quotient`) with
+    PRODUCTION inputs — a real prove runs with the host quotient hooked, so
+    blinds/grand products/challenges are the ones a prover would see — and
+    byte-check every timed device run against the host result. With >1
+    device up (the multichip variant) the mesh-sharded pipeline engages and
+    `quotient_sharded_degraded` must stay at zero (BENCH_EXPECT_SHARDED=1
+    turns any degrade into a hard error)."""
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    from spectre_tpu.plonk.backend import setup_compile_cache
+    setup_compile_cache()
+
+    import spectre_tpu.plonk.prover as P
+    from spectre_tpu.observability import compilelog, tracing
+    from spectre_tpu.plonk import backend as B, quotient_device as QD
+    from spectre_tpu.test_utils import (mesh_prove_fixture,
+                                        seeded_blinding_rng)
+    from spectre_tpu.utils.health import HEALTH
+    from spectre_tpu.utils.profiling import phase
+    compilelog.install()
+
+    kk = int(os.environ.get("BENCH_QUOTIENT_K", "11"))
+    srs, pk, asg = mesh_prove_fixture(k=kk)
+
+    cap = {}
+    orig_q = P._quotient_host
+
+    def wrapped(cfg_, dom_, bk_, pk_, polys_, beta, gamma, y):
+        h_host = orig_q(cfg_, dom_, bk_, pk_, polys_, beta, gamma, y)
+
+        def fetch(key):
+            kind, j = key
+            if key in polys_:
+                return polys_[key]
+            if kind == "shk":
+                return pk_.sha_k_poly
+            return {"q": pk_.selector_polys, "fix": pk_.fixed_polys,
+                    "sig": pk_.sigma_polys, "tab": pk_.table_polys,
+                    "shq": pk_.sha_selector_polys}[kind][j]
+
+        cap.update(cfg=cfg_, dom=dom_, fetch=fetch, beta=beta,
+                   gamma=gamma, y=y, h_host=h_host)
+        return h_host
+
+    with tracing.trace(f"bench-quotient-k{kk}") as tr, \
+            compilelog.capture() as cev:
+        with phase("bench/prove_host"):
+            P._quotient_host = wrapped
+            try:
+                P.prove(pk, srs, asg, B.CpuBackend(),
+                        blinding_rng=seeded_blinding_rng())
+            finally:
+                P._quotient_host = orig_q
+
+        ndev = jax.local_device_count()
+        deg0 = HEALTH.snapshot()["counters"].get(
+            "quotient_sharded_degraded", 0)
+
+        def run():
+            return QD.compute_quotient(cap["cfg"], cap["dom"], cap["fetch"],
+                                       cap["beta"], cap["gamma"], cap["y"])
+
+        with phase("bench/warmup_compile"):
+            got = run()
+        dt = float("inf")
+        for _ in range(3):
+            with phase("bench/run"):
+                t0 = time.time()
+                got = run()
+                dt = min(dt, time.time() - t0)
+        degraded = HEALTH.snapshot()["counters"].get(
+            "quotient_sharded_degraded", 0) - deg0
+        if not np.array_equal(got, cap["h_host"]):
+            with open(out_path, "w") as f:
+                json.dump({"error": f"device quotient k={kk} != host "
+                           "quotient bytes",
+                           "backend": jax.default_backend()}, f)
+            return
+        if os.environ.get("BENCH_EXPECT_SHARDED") == "1" and degraded:
+            with open(out_path, "w") as f:
+                json.dump({"error": f"quotient mesh path degraded "
+                           f"{degraded}x on the happy path "
+                           f"(n_devices={ndev})",
+                           "backend": jax.default_backend()}, f)
+            return
+
+    comp = compilelog.summarize(cev)
+    with open(out_path, "w") as f:
+        json.dump({"quotients_per_s": 1.0 / dt,
+                   "quotient_s": round(dt, 3),
+                   "quotient_k": kk,
+                   "n_devices": ndev,
+                   "sharded_degraded": degraded,
+                   "ntt_mode": bench_ntt_mode(),
+                   "ntt_kernel": os.environ.get("SPECTRE_NTT_KERNEL",
+                                                "stages"),
+                   "phase_seconds": tracing.phase_seconds(tr),
+                   "compile_seconds": comp["seconds"],
+                   "compile_count": comp["count"],
+                   "backend": jax.default_backend()}, f)
+
+
 def multichip_device_phase(out_path: str):
     """Child process: N virtual-device mesh prove + MSM/NTT micro-bench.
 
@@ -575,7 +680,8 @@ def _run_child(force_cpu: bool, expect: str, timeout: float,
             pass
 
 
-def _run_multichip_child(timeout: float):
+def _run_multichip_child(timeout: float, kind: str = "multichip",
+                         extra_env: dict | None = None):
     """Launch the multichip phase: fresh process (XLA_FLAGS must precede
     jax init), hard deadline, rc + stderr tail captured for the failure
     record (the MULTICHIP_r01-r05 logs all died as bare rc=124 with no
@@ -590,11 +696,12 @@ def _run_multichip_child(timeout: float):
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         flags += f" --xla_force_host_platform_device_count={ndev}"
-    env = dict(os.environ, BENCH_PHASE="device", BENCH_KIND="multichip",
+    env = dict(os.environ, BENCH_PHASE="device", BENCH_KIND=kind,
                BENCH_OUT=out, JAX_PLATFORMS="cpu", XLA_FLAGS=flags.strip())
     # the shard gates must engage for 2^12 micro-kernels + the k=13 prove
     env.setdefault("SPECTRE_SHARD_MSM_MIN_LOGN", "10")
     env.setdefault("SPECTRE_SHARD_NTT_MIN_LOGN", "10")
+    env.update(extra_env or {})
     rc, tail = None, ""
     try:
         with open(logpath, "w") as logf:
@@ -753,6 +860,8 @@ def main():
         kind = os.environ.get("BENCH_KIND")
         if kind == "ntt":
             ntt_device_phase(os.environ["BENCH_OUT"])
+        elif kind == "quotient":
+            quotient_device_phase(os.environ["BENCH_OUT"])
         elif kind == "multichip":
             multichip_device_phase(os.environ["BENCH_OUT"])
         else:
@@ -792,10 +901,14 @@ def main():
         ok = bench_ntt(fast) and ok
     if which in ("all", "serve"):
         ok = bench_serve(fast) and ok
+    if which in ("all", "quotient"):
+        ok = bench_quotient(fast) and ok
     # multichip is opt-in (BENCH_METRIC=multichip / make bench-multichip):
     # the k=13 mesh prove is minutes-scale even warm, too heavy for "all"
     if which == "multichip":
         ok = bench_multichip(fast) and ok
+    if which == "quotient_multichip":
+        ok = bench_quotient_multichip(fast) and ok
     if not ok:
         sys.exit(1)
 
@@ -1007,6 +1120,88 @@ def bench_ntt(fast: bool) -> bool:
         record["compile_count"] = result.get("compile_count", 0)
     return _emit(record, fast, f"bn254_ntt_2^{logn}_cpu_polys_per_s",
                  "polys/s")
+
+
+def bench_quotient(fast: bool) -> bool:
+    """Quotient-phase latency (BENCH_METRIC=quotient / make bench-quotient):
+    the child runs a real prove with the host quotient hooked to capture
+    production inputs, then times byte-checked `compute_quotient` runs.
+    --fast gates k=11 against the checked-in floor; the full tier adds an
+    ungated k=13 datapoint (BENCH_QUOTIENT_KS overrides)."""
+    default_ks = "11" if fast else "11,13"
+    ks = [int(s) for s in os.environ.get("BENCH_QUOTIENT_KS",
+                                         default_ks).split(",") if s]
+    timeout = float(os.environ.get("BENCH_QUOTIENT_TIMEOUT", "1800"))
+    ok = True
+    for kk in ks:
+        os.environ["BENCH_QUOTIENT_K"] = str(kk)
+        result = _run_child(True, "", timeout, kind="quotient")
+        if not result:
+            print(json.dumps({"metric": f"quotient_k{kk} latency",
+                              "value": 0, "unit": "quotients/s",
+                              "backend": None, "failed": True}))
+            ok = False
+            continue
+        record = {
+            "metric": f"quotient_k{kk} latency",
+            "value": round(result["quotients_per_s"], 3),
+            "unit": "quotients/s",
+            "quotient_s": result["quotient_s"],
+            "n_devices": result["n_devices"],
+            "sharded_degraded": result["sharded_degraded"],
+            "backend": result.get("backend"),
+            "ntt_mode": result.get("ntt_mode"),
+            "ntt_kernel": result.get("ntt_kernel"),
+        }
+        if result.get("phase_seconds"):
+            record["phase_seconds"] = result["phase_seconds"]
+        if result.get("compile_seconds") is not None:
+            record["compile_seconds"] = result["compile_seconds"]
+            record["compile_count"] = result.get("compile_count", 0)
+        ok = _emit(record, fast, f"quotient_k{kk}_cpu_per_s",
+                   "quotients/s") and ok
+    return ok
+
+
+def bench_quotient_multichip(fast: bool) -> bool:
+    """8-virtual-device mesh quotient (BENCH_METRIC=quotient_multichip /
+    make bench-quotient-multichip): same child as bench_quotient on an
+    N-device mesh — the sharded pipeline MUST engage (BENCH_EXPECT_SHARDED
+    turns any `quotient_sharded_degraded` tick into a hard error) and
+    every timed run is byte-checked against the host quotient."""
+    ndev = int(os.environ.get("SPECTRE_BENCH_DEVICES", "8"))
+    kk = int(os.environ.get("BENCH_QUOTIENT_K", "13"))
+    budget = float(os.environ.get("BENCH_QUOTIENT_TIMEOUT", "2700"))
+    result, rc, tail = _run_multichip_child(
+        budget, kind="quotient",
+        extra_env={"BENCH_QUOTIENT_K": str(kk), "BENCH_EXPECT_SHARDED": "1",
+                   "SPECTRE_SHARD_QUOTIENT_MIN_LOGN": "10"})
+    if not result:
+        print(json.dumps({
+            "metric": f"quotient_k{kk}_multichip{ndev} latency",
+            "value": 0, "unit": "quotients/s", "backend": None,
+            "n_devices": ndev, "failed": True, "rc": rc,
+            "tail": tail[-800:]}))
+        return False
+    record = {
+        "metric": f"quotient_k{kk}_multichip{ndev} latency",
+        "value": round(result["quotients_per_s"], 3),
+        "unit": "quotients/s",
+        "quotient_s": result["quotient_s"],
+        "n_devices": result["n_devices"],
+        "sharded_degraded": result["sharded_degraded"],
+        "backend": result.get("backend"),
+        "ntt_mode": result.get("ntt_mode"),
+        "ntt_kernel": result.get("ntt_kernel"),
+        "budget_s": budget,
+    }
+    if result.get("phase_seconds"):
+        record["phase_seconds"] = result["phase_seconds"]
+    if result.get("compile_seconds") is not None:
+        record["compile_seconds"] = result["compile_seconds"]
+        record["compile_count"] = result.get("compile_count", 0)
+    return _emit(record, fast, f"quotient_k{kk}_multichip{ndev}_per_s",
+                 "quotients/s")
 
 
 def _emit(record: dict, fast: bool, floor_key: str, unit: str) -> bool:

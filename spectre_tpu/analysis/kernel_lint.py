@@ -616,6 +616,23 @@ def _build_dft_matmul():
     return build
 
 
+def _build_dft_matmul_split():
+    def build():
+        import jax.numpy as jnp
+        from ..ops import ntt as NTT
+        from ..plonk.domain import Domain
+        # the two-level carry split (n > 1024 production path) traced at a
+        # lintable length by forcing the group width: each group's one-hot
+        # slice has column |sum| ≤ 4, so the refinement proves the grouped
+        # bound n·255²·W — the same structure `lint_matmul_cap` scales to
+        # the shipped _MATMUL_MAX_LOGN analytically
+        omega = Domain(6).omega
+        a = jnp.asarray(_u32((64, 16)))
+        return (lambda x: NTT._ntt_dft_matmul(x, 6, omega,
+                                              group_width=4)), (a,)
+    return build
+
+
 def _build_coset_intt_std_vinv():
     def build():
         import jax.numpy as jnp
@@ -813,6 +830,52 @@ def _build_sharded_ntt_cols():
     return build
 
 
+# --- mesh-sharded quotient (ISSUE 19): per-shard bodies of the sharded
+# LDE prefetch and the fused inverse boundary, traced single-shard like the
+# sharded NTT above (the pointwise eval/roll runners contain only field_ops
+# primitives and ppermute/concat — nothing beyond roots already covered).
+
+def _build_sharded_quotient_lde():
+    def build():
+        import jax.numpy as jnp
+        from ..parallel.sharded_quotient import _lde_local
+        from ..plonk.domain import COSET_GEN, Domain
+        omega = Domain(3).omega
+        stack = jnp.asarray(_u32((2, 8, 16)))   # std-form columns
+        return (lambda s: _lde_local(s, omega, COSET_GEN, "radix2",
+                                     "stages")), (stack,)
+    return build
+
+
+def _build_sharded_quotient_inv_rows():
+    def build():
+        import jax.numpy as jnp
+        from ..fields import bn254
+        from ..parallel.sharded_quotient import _inv_rows_local
+        from ..plonk.domain import Domain
+        omega_row = pow(Domain(3).omega, -1, bn254.R)
+        block = jnp.asarray(_u32((4, 8, 16)))
+        scb = jnp.asarray(_u32((4, 8, 16)))     # vinv stage-0 pre-scale
+        twb = jnp.asarray(_u32((4, 8, 16)))
+        return (lambda b, s, t: _inv_rows_local(
+            b, s, t, omega_row, "radix2", "stages")), (block, scb, twb)
+    return build
+
+
+def _build_sharded_quotient_inv_cols():
+    def build():
+        import jax.numpy as jnp
+        from ..fields import bn254
+        from ..parallel.sharded_quotient import _inv_cols_local
+        from ..plonk.domain import Domain
+        omega_col = pow(Domain(3).omega, -1, bn254.R)
+        y = jnp.asarray(_u32((4, 8, 16)))
+        outb = jnp.asarray(_u32((4, 8, 16)))    # raw combined out table
+        return (lambda b, o: _inv_cols_local(
+            b, o, omega_col, "radix2", "stages")), (y, outb)
+    return build
+
+
 def _build_field_mxu():
     def build():
         from ..ops import field_mxu as M
@@ -885,6 +948,8 @@ KERNELS = [
                _build_ntt_fourstep_matmul()),
     KernelSpec("ntt.dft_matmul", "spectre_tpu/ops/ntt.py",
                _build_dft_matmul()),
+    KernelSpec("ntt.dft_matmul_split", "spectre_tpu/ops/ntt.py",
+               _build_dft_matmul_split()),
     KernelSpec("ntt.coset_intt_std_vinv", "spectre_tpu/ops/ntt.py",
                _build_coset_intt_std_vinv()),
     # Pallas MSM complete-add body: the exact jaxpr pallas_call runs per
@@ -945,6 +1010,15 @@ KERNELS = [
     KernelSpec("sharded_ntt.cols_shard",
                "spectre_tpu/parallel/sharded_ntt.py",
                _build_sharded_ntt_cols()),
+    KernelSpec("sharded_quotient.lde_shard",
+               "spectre_tpu/parallel/sharded_quotient.py",
+               _build_sharded_quotient_lde()),
+    KernelSpec("sharded_quotient.inv_rows_shard",
+               "spectre_tpu/parallel/sharded_quotient.py",
+               _build_sharded_quotient_inv_rows()),
+    KernelSpec("sharded_quotient.inv_cols_shard",
+               "spectre_tpu/parallel/sharded_quotient.py",
+               _build_sharded_quotient_inv_cols()),
     # MXU int8-limb matmul field multiply (shapes stabilized; the
     # dot_general rule reads its preferred_element_type accumulator)
     KernelSpec("field_mxu.mont_mul", "spectre_tpu/ops/field_mxu.py",
@@ -993,6 +1067,71 @@ def lint_limbs_host() -> list:
     return out
 
 
+def lint_matmul_cap() -> list:
+    """PROVE the DFT-matmul exactness budget at the shipped
+    `ntt._MATMUL_MAX_LOGN` — closed-form over exact host integers, so the cap
+    is a theorem, not an assertion. The traced `ntt.dft_matmul*` specs walk
+    the real jaxpr structure at a lintable length; this check scales the same
+    bounds to the cap, where materializing the [n, n·32] table (512 MB at
+    n=4096) is not lintable. Any cap bump without re-widening the group
+    split / REDC radix lands here as a KL-OVERFLOW error."""
+    from ..ops import field_mxu as MX
+    from ..ops import field_ops as F
+    from ..ops import ntt as NTT
+
+    out = []
+    file = "spectre_tpu/ops/ntt.py"
+    int32 = (1 << 31) - 1
+
+    def bad(detail, msg):
+        out.append(Finding("kernel", "KL-OVERFLOW", Severity.ERROR, file,
+                           "ntt.matmul_cap", msg,
+                           key=f"KL-OVERFLOW:ntt.matmul_cap:{detail}"))
+
+    logn = NTT._MATMUL_MAX_LOGN
+    n = 1 << logn
+    p = F.fr_ctx().p
+    width = NTT._conv_group_width(logn)
+
+    # (1) first dot_general column: x8 lanes ≤ 255 times the twiddle-limb
+    # matrix's worst contraction column |sum| ≤ 255·n (entries are 8-bit)
+    if 255 * 255 * n > int32:
+        bad("dot-g", f"point-axis dot_general column 255²·n = {255*255*n} "
+            f"exceeds int32 at n={n}")
+    # (2) grouped one-hot collapse + carry scan: the REAL conv matrix's
+    # per-group column count times the per-product bound, plus the running
+    # carry (≤ peak/255) — peak W·n·255·256
+    s = MX.conv_matrix(MX.L8, MX.L8, 63)
+    for lo in range(0, MX.L8, width):
+        colsum = int(np.abs(s[lo * MX.L8:(lo + width) * MX.L8]
+                            .astype(np.int64)).sum(axis=0).max())
+        peak = colsum * n * 255 * 256       # column sum + carry-scan remainder
+        if peak > int32:
+            bad("conv-col", f"grouped collapse column at i1∈[{lo},{lo+width})"
+                f": colsum {colsum} · n·255·256 = {peak} exceeds int32 at "
+                f"the shipped cap n={n} (widen the split: _conv_group_width)")
+    # (3) group-sum renormalization: ≤ ceil(32/W) exact 8-bit lanes per limb
+    groups = (MX.L8 + width - 1) // width
+    if groups * 255 + groups > int32:       # trivially true; kept explicit
+        bad("group-sum", "group-sum lanes exceed int32")
+    # (4) t and m·p fit the declared limb count
+    if n * p * p >= 1 << (8 * NTT._T_LIMBS):
+        bad("t-limbs", f"t < n·p² needs more than _T_LIMBS={NTT._T_LIMBS} "
+            f"8-bit limbs at n={n}")
+    if (1 << NTT._REDC_SHIFT) * p >= 1 << (8 * NTT._T_LIMBS):
+        bad("mp-limbs", f"m·p < 2^{NTT._REDC_SHIFT}·p overflows "
+            f"_T_LIMBS={NTT._T_LIMBS} limbs")
+    # (5) single-REDC full reduction: u < n·p²/2^shift + p < 2p needs
+    # n·p < 2^shift — the one conditional subtract is only sound under it
+    if n * p >= 1 << NTT._REDC_SHIFT:
+        bad("redc", f"single-REDC bound n·p < 2^{NTT._REDC_SHIFT} fails at "
+            f"n={n}: u < 2p no longer holds (raise _REDC_SHIFT)")
+    # (6) REDC limb products: mul_columns columns ≤ limbs·255²
+    if NTT._REDC_LIMBS * 255 * 255 > int32:
+        bad("mul-cols", "REDC mul_columns column exceeds int32")
+    return out
+
+
 def lint_kernel(spec: KernelSpec) -> list:
     fn, args = spec.build()
     return lint_fn(fn, args, name=spec.name, file=spec.file,
@@ -1007,4 +1146,6 @@ def lint_all_kernels(names=None) -> list:
         findings += lint_kernel(spec)
     if not names or "limbs.host" in names:
         findings += lint_limbs_host()
+    if not names or "ntt.matmul_cap" in names:
+        findings += lint_matmul_cap()
     return findings

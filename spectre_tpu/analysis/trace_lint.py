@@ -619,6 +619,38 @@ def _probe_sharded_ntt():
     return run, (a,)
 
 
+def _probe_sharded_quotient():
+    import importlib
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..fields import bn254
+    from ..parallel.plan import current_plan
+    SQ = importlib.import_module("spectre_tpu.parallel.sharded_quotient")
+    plan = current_plan()
+    d = plan.n_devices
+    # 2^6 extended domain: Bailey 8x8, divisible by any pow2 mesh <= 8
+    m, logm = 64, 6
+    om = bn254.fr_root_of_unity(logm)
+    g = 7  # COSET_GEN
+    a = jnp.zeros((m, 16), jnp.uint32)
+    stack = jnp.zeros((max(d, 2), m, 16), jnp.uint32)
+    s = jnp.zeros((16,), jnp.uint32)
+
+    def run(x, st, sc):
+        # one pass through all four runner caches: eval (mul + fold),
+        # roll, batch-sharded LDE, fused inverse (tables resident)
+        ev = SQ._eval_runner(plan, "mul", m)(x, x)
+        ev = SQ._eval_runner(plan, "fold", m)(ev, sc, x)
+        r = SQ._roll_runner(plan, m, 4)(ev)
+        lde = SQ._lde_runner(plan, st.shape[0], logm, om, g)(st)
+        inv = SQ._inv_apply(plan, np.asarray(r), logm, om, g, (1,))
+        return lde, inv
+
+    return run, (a, stack, s)
+
+
 def _probe_batch_msm():
     import jax.numpy as jnp
 
@@ -647,6 +679,8 @@ PROBES = [
               _probe_sharded_fixed),
     ProbeSpec("sharded_ntt", "spectre_tpu/parallel/sharded_ntt.py",
               _probe_sharded_ntt),
+    ProbeSpec("sharded_quotient", "spectre_tpu/parallel/sharded_quotient.py",
+              _probe_sharded_quotient),
     ProbeSpec("batch_msm.dp", "spectre_tpu/parallel/batch_msm.py",
               _probe_batch_msm),
 ]

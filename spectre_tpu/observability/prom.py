@@ -82,13 +82,16 @@ def _lru_stats() -> list[tuple[str, dict]]:
     ALREADY imported — sys.modules only, so a scrape of an idle service
     never pays the jax import for ops it hasn't used."""
     items = []
-    for cache, mod in (("msm", "spectre_tpu.ops.msm"),
-                       ("ntt", "spectre_tpu.ops.ntt")):
+    for cache, mod, fn in (
+            ("msm", "spectre_tpu.ops.msm", "lru_stats"),
+            ("ntt", "spectre_tpu.ops.ntt", "lru_stats"),
+            ("quotient_scalar", "spectre_tpu.plonk.quotient_device",
+             "scalar_lru_stats")):
         m = sys.modules.get(mod)
         if m is None:
             continue
         try:
-            items.append((cache, m.lru_stats()))
+            items.append((cache, getattr(m, fn)()))
         except Exception:
             continue
     return items

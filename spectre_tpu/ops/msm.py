@@ -335,10 +335,10 @@ def _msm_pallas(points, scalars, c, mode: str, base_key):
 
     n = points.shape[0]
     if mode == "vanilla":
-        # cap at 11: the kernel keeps all nwin bucket arrays VMEM-resident
-        # and 254-bit scalars triple nwin vs the GLV paths (see the VMEM
-        # budget note in msm_pallas)
-        cc = c if c is not None else min(default_window(n), 11)
+        # default_window_pallas caps at 11: the kernel keeps all nwin bucket
+        # arrays VMEM-resident and 254-bit scalars double nwin vs the GLV
+        # paths (see the VMEM budget note in msm_pallas)
+        cc = c if c is not None else default_window_pallas(n)
         return MP.combine_windows_soa(
             MP.msm_bucket_windows(MP.to_soa(points), scalars, None, cc, 254),
             cc)
@@ -346,7 +346,7 @@ def _msm_pallas(points, scalars, c, mode: str, base_key):
     from . import glv
     nbits = glv.glv_bits()
     if mode == "fixed":
-        cf = c if c is not None else default_window_fixed(2 * n)
+        cf = c if c is not None else default_window_pallas(2 * n, signed=True)
         if _degrade_fixed(n, cf, nbits):
             mode = "glv+signed"
         else:
@@ -356,7 +356,7 @@ def _msm_pallas(points, scalars, c, mode: str, base_key):
             return MP.msm_bucket_fixed(
                 MP.to_soa_windows(table), sc2, neg, cf, nbits)
 
-    cc = c if c is not None else default_window(2 * n, signed=True)
+    cc = c if c is not None else default_window_pallas(2 * n, signed=True)
     pts2, sc2, neg = glv_split(points, scalars)
     return MP.combine_windows_soa(
         MP.msm_bucket_windows(MP.to_soa(pts2), sc2, neg, cc, nbits), cc)
@@ -635,6 +635,44 @@ def default_window_fixed(n: int) -> int:
     signed tuning table applies; table MEMORY scales with nwin*n, which
     the larger signed windows also help."""
     return default_window(n, signed=True)
+
+
+# VMEM the pallas bucket kernel may spend on resident bucket arrays. 8 MB
+# leaves half of a 16 MB core for the double-buffered point DMA and the
+# aggregation scratch (see the budget note in msm_pallas).
+_PALLAS_BUCKET_VMEM_BUDGET = 8 << 20
+
+
+def _pallas_bucket_bytes(c: int, nbits: int) -> int:
+    """Bytes of VMEM the kernel's resident buckets claim at window width c:
+    all nwin [48, 2^(c-1)] u32 bucket arrays live for the whole grid."""
+    nwin = (nbits + c) // c
+    return nwin * 48 * (1 << (c - 1)) * 4
+
+
+def default_window_pallas(n: int, signed: bool = False) -> int:
+    """Window table for the pallas bucket kernel (SPECTRE_MSM_IMPL=pallas).
+
+    The XLA table tunes around _aggregate_buckets' materialized select; the
+    bucket kernel's binding constraint is VMEM residency instead, so it gets
+    its own table: start from the XLA width for the size class and shrink
+    until the resident buckets fit _PALLAS_BUCKET_VMEM_BUDGET. 254-bit
+    vanilla scalars (nwin ~ 254/c, roughly double the GLV window count)
+    land on c <= 11 (~4.5 MB) where c = 13 would claim ~15 MB; the 126-bit
+    signed/GLV paths fit their XLA widths unchanged (c = 13 is 7.5 MB).
+    The CPU interpret-mode sweep in BASELINE.md (PR 19) byte-checks every
+    width and records compile cost; it is NOT a silicon tuning run, so the
+    table is sized by the VMEM budget, not by those timings.
+    SPECTRE_MSM_WINDOW still overrides the whole table (via
+    default_window)."""
+    from . import glv
+    nbits = glv.glv_bits() if signed else 254
+    c = default_window(n, signed=signed)
+    if window_override() is not None:
+        return c
+    while c > 1 and _pallas_bucket_bytes(c, nbits) > _PALLAS_BUCKET_VMEM_BUDGET:
+        c -= 1
+    return c
 
 
 def msm(points, scalars, c: int | None = None, mode: str | None = None,

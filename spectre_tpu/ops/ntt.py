@@ -77,12 +77,28 @@ NTT_KERNELS = ("stages", "matmul")
 _FOURSTEP_MIN_LOGN = 2
 
 # Longest short transform the matmul kernel accepts. Two independent budgets
-# pin the same cap: the one-hot reduction's int32 column bound C·L·255² =
-# 1024·32·255² = 2,131,230,720 < 2^31-1 (exactly fits at n=1024, overflows at
-# 2048), and the single-REDC full-reduction bound n·p²/2^264 < p (u < 2p so
-# one conditional subtract canonicalizes; fails for n > 1024). Fourstep short
-# legs are ~sqrt(n_ext), so this covers every domain up to n_ext = 2^20.
-_MATMUL_MAX_LOGN = 10
+# pin the cap (PROVED, not asserted, by analysis/kernel_lint.lint_matmul_cap
+# — bump this and the lint fails until the budgets are re-widened):
+#   * int32 columns in the one-hot collapse: the i1 axis splits into groups
+#     of `_conv_group_width(logn)` limbs (two-level carry split), so each
+#     convolution column sums at most W products of ≤ n·255² plus the carry
+#     scan's running remainder — peak W·n·255·256 ≤ 2^15·255·256 =
+#     2,139,095,040 < 2^31-1 for every logn ≤ 12 (W = 2^(15-logn), capped
+#     at 32: n ≤ 1024 needs no split and keeps the PR 15 single-matmul
+#     collapse).
+#   * single-REDC full reduction with radix 2^272: u < n·p²/2^272 + p < 2p
+#     needs n·p < 2^272, i.e. n < 2^18 — one conditional subtract
+#     canonicalizes through n = 4096 with 2^14 to spare.
+# Fourstep short legs are ~sqrt(n_ext), so cap 12 keeps every extended
+# domain up to n_ext = 2^24 (k = 22) on the MXU matmul path.
+_MATMUL_MAX_LOGN = 12
+
+
+def _conv_group_width(logn: int) -> int:
+    """i1-axis group width of the two-level carry split: largest W with
+    W·n ≤ 2^15 (the int32 column + carry-scan budget above), capped at the
+    full 32-limb axis — n ≤ 1024 stays on the unsplit single-matmul path."""
+    return 1 << min(5, max(0, 15 - logn))
 
 
 def ntt_mode() -> str:
@@ -298,17 +314,22 @@ def _vinv_in_table(logn: int, vals: tuple) -> np.ndarray:
 # matmul kernel: short transforms as DFT matrix products in the limb domain
 # ---------------------------------------------------------------------------
 
-# Reduction radix for the matmul kernel's single REDC: one extra 8-bit limb
-# over the 2^256 Montgomery radix. W entries carry the compensating 2^264
-# factor, so after dividing by 2^264 the result is back in plain Montgomery
-# form (factor R = 2^256) and byte-identical to the stages kernel.
-_REDC_SHIFT = 264
-_REDC_LIMBS = _REDC_SHIFT // 8               # 33
+# Reduction radix for the matmul kernel's single REDC: two extra 8-bit limbs
+# over the 2^256 Montgomery radix. W entries carry the compensating 2^272
+# factor, so after dividing by 2^272 the result is back in plain Montgomery
+# form (factor R = 2^256) and byte-identical to the stages kernel. The radix
+# sets the single-REDC length budget n < 2^272/p ≈ 2^18 (see the
+# _MATMUL_MAX_LOGN note) — PR 15's 2^264 capped it at n = 1024.
+_REDC_SHIFT = 272
+_REDC_LIMBS = _REDC_SHIFT // 8               # 34
+# t = Σ ω^{jk}·2^272·x_j < n·p² < 2^520 at the cap: 66 limbs hold both t and
+# m·p < 2^272·p < 2^526, and the REDC high half (u < 2p) is the top 32
+_T_LIMBS = _REDC_LIMBS + 32                  # 66
 
 
 @functools.cache
 def _matmul_consts():
-    """(p' = -p^{-1} mod 2^264 as 33 limbs, p as 32 limbs), int32 8-bit."""
+    """(p' = -p^{-1} mod 2^272 as 34 limbs, p as 32 limbs), int32 8-bit."""
     p = F.fr_ctx().p
     r1 = 1 << _REDC_SHIFT
     pinv = (-pow(p, -1, r1)) % r1
@@ -320,7 +341,7 @@ def _matmul_consts():
 
 def _dft_matrix8(logn: int, omega: int) -> np.ndarray:
     """8-bit-limb DFT matrix for the matmul kernel, contraction-ready:
-    Wt[j, k*32 + i] = limb i of (omega^{jk} · 2^264 mod p), uint8 [n, n*32].
+    Wt[j, k*32 + i] = limb i of (omega^{jk} · 2^272 mod p), uint8 [n, n*32].
     One dot_general contracting the point axis j then yields every output
     point's raw limb-pair products in one MXU-shaped matmul. LRU-budgeted
     (uint8 keeps the n=1024 table at 32 MB host-side)."""
@@ -342,26 +363,36 @@ def _dft_matrix8(logn: int, omega: int) -> np.ndarray:
     return _TABLES.put(key, None, out.reshape(n, n * 32))
 
 
-def _ntt_dft_matmul(a, logn: int, omega: int):
+def _ntt_dft_matmul(a, logn: int, omega: int, group_width: int | None = None):
     """Direct DFT of axis -2 of a [..., n, 16] Montgomery limb tensor as one
     limb-domain matrix product (the arXiv:2604.17808 MXU mapping):
 
-        T[k] = sum_j (omega^{jk}·2^264) · x_j  <  n·p²     (exact, int32 cols)
-        out[k] = REDC_264(T[k])                            (one reduction)
+        T[k] = sum_j (omega^{jk}·2^272) · x_j  <  n·p²     (exact, int32 cols)
+        out[k] = REDC_272(T[k])                            (one reduction)
 
     The point-axis contraction is ONE dot_general against the precomputed
     [n, n*32] twiddle-limb matrix; the limb-pair products then collapse to
     convolution columns through `field_mxu.conv_matrix`'s one-hot matmul.
-    Each column is bounded by C·L·255² = n·32·255² ≤ 2,131,230,720 < 2^31-1
-    (the `_MATMUL_MAX_LOGN` budget), and a single 2^264-radix REDC fully
-    reduces: u < n·p²/2^264 + p < 2p, one conditional subtract canonicalizes.
-    Canonical in, canonical out — byte-identical to `_ntt_stages`."""
+    Past n = 1024 a single collapse overflows int32 (C·L·255² at L = 32), so
+    the i1 limb axis splits into groups of `_conv_group_width(logn)` — each
+    group's columns carry-propagate to exact 8-bit limbs independently
+    (level 1), then the per-group limb tensors sum (≤ 4·255 per lane) and
+    one more carry pass renormalizes (level 2). Value-preserving, so the
+    result is bit-exact for any group width; the width only bounds the int32
+    partial sums (W·n·255·256 < 2^31, proved by kernel_lint's cap check).
+    `group_width` overrides the split for tests/lint probes — small n can
+    exercise the grouped path cheaply.
+
+    A single 2^272-radix REDC then fully reduces: u < n·p²/2^272 + p < 2p,
+    one conditional subtract canonicalizes. Canonical in, canonical out —
+    byte-identical to `_ntt_stages`."""
     from . import field_mxu as MX
 
     ctx = F.fr_ctx()
     n = 1 << logn
     pinv8, p8 = _matmul_consts()
     wt = jnp.asarray(_dft_matrix8(logn, omega)).astype(jnp.int32)
+    width = group_width if group_width is not None else _conv_group_width(logn)
 
     x8 = MX._to8(a)                           # [..., n, 32] int32, limbs i2
     # G[..., k, i1, i2] = sum_j Wt[j, (k,i1)] * x8[..., j, i2]: the one
@@ -371,20 +402,32 @@ def _ntt_dft_matmul(a, logn: int, omega: int):
         preferred_element_type=jnp.int32)     # [..., i2, n*32]
     g = g.reshape(g.shape[:-2] + (MX.L8, n, MX.L8))   # [..., i2, k, i1]
     g = jnp.moveaxis(g, -3, -1)               # [..., k, i1, i2]
-    flat = g.reshape(g.shape[:-2] + (MX.L8 * MX.L8,))
     s = MX.conv_matrix(MX.L8, MX.L8, 63)      # columns of a 32x32 conv
-    t_cols = jax.lax.dot_general(
-        flat, s, (((flat.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32)     # [..., k, 63] < C·L·255²
-
-    # REDC with radix 2^264: t < n·p² < 2^518 needs 65 8-bit limbs
-    t8 = MX._carry8(t_cols, 65)
+    if width >= MX.L8:
+        flat = g.reshape(g.shape[:-2] + (MX.L8 * MX.L8,))
+        t_cols = jax.lax.dot_general(
+            flat, s, (((flat.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)  # [..., k, 63] < C·L·255²
+        t8 = MX._carry8(t_cols, _T_LIMBS)
+    else:
+        # two-level carry split (see docstring): group the i1 axis
+        t8 = None
+        for lo in range(0, MX.L8, width):
+            part = g[..., lo:lo + width, :]
+            flat = part.reshape(part.shape[:-2] + (width * MX.L8,))
+            cols = jax.lax.dot_general(
+                flat, s[lo * MX.L8:(lo + width) * MX.L8],
+                preferred_element_type=jnp.int32,
+                dimension_numbers=(((flat.ndim - 1,), (0,)), ((), ())))
+            p8g = MX._carry8(cols, _T_LIMBS)  # exact per-group limbs
+            t8 = p8g if t8 is None else t8 + p8g
+        t8 = MX._carry8(t8, _T_LIMBS)         # lanes ≤ G·255: renormalize
     t_lo = t8[..., :_REDC_LIMBS]
     m_cols = MX.mul_columns(t_lo, jnp.asarray(pinv8), _REDC_LIMBS)
-    m8 = MX._carry8(m_cols, _REDC_LIMBS)      # m = t·p' mod 2^264
-    mp_cols = MX.mul_columns(m8, jnp.asarray(p8), 65)
+    m8 = MX._carry8(m_cols, _REDC_LIMBS)      # m = t·p' mod 2^272
+    mp_cols = MX.mul_columns(m8, jnp.asarray(p8), _T_LIMBS)
 
-    # low 33 limbs of t + m·p are 0 mod 2^264 by construction: propagate
+    # low 34 limbs of t + m·p are 0 mod 2^272 by construction: propagate
     # them only for the carry into the high half (carry ≤ 1)
     low_sum = t_lo + mp_cols[..., :_REDC_LIMBS]
     low_t = jnp.moveaxis(low_sum, -1, 0)

@@ -232,6 +232,7 @@ def current_plan() -> ShardingPlan:
 RUNNER_REGISTRY_MODULES = (
     "spectre_tpu.parallel.sharded_msm",
     "spectre_tpu.parallel.sharded_ntt",
+    "spectre_tpu.parallel.sharded_quotient",
     "spectre_tpu.parallel.batch_msm",
     "spectre_tpu.plonk.quotient_device",
     "spectre_tpu.plonk.backend",

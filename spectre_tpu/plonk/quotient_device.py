@@ -17,6 +17,15 @@ batched NTT, honoring SPECTRE_NTT_MODE). The inverse path folds the 1/n
 iNTT scale, the g^{-i} coset unscale and the mont→std boundary into one
 table multiply (`coset_intt_std`).
 
+ISSUE 19: the pipeline is ENGINE-parameterized. The single-device engine
+below is the original path verbatim; when more than one device is up (and
+the domain clears the size gate) `compute_quotient` dispatches the same
+pipeline through `parallel/sharded_quotient.py`, which runs the LDE
+prefetch, every expression primitive, the rotations and the fused inverse
+as shard_map programs over the ShardingPlan mesh. Ineligible shapes or a
+mesh-path failure fall back here VISIBLY — the `quotient_sharded_degraded`
+ServiceHealth counter plus a provenance event — never silently.
+
 Design note (learned the hard way): tracing the WHOLE tree into one jitted
 XLA program blows up LLVM codegen on the CPU backend (`Cannot allocate
 memory` from the execution engine at ~6k fused scan-heavy ops). The ops are
@@ -27,7 +36,8 @@ compile cost stays bounded per primitive shape.
 
 Parity: the device path produces EXACTLY the host path's u64 coefficient
 arrays, compared in-situ during real proves
-(tests/test_plonk.py::TestDeviceQuotient, gate+lookup and wide-SHA shapes).
+(tests/test_plonk.py::TestDeviceQuotient, gate+lookup and wide-SHA shapes;
+mesh-vs-host in tests/test_quotient_sharded.py).
 """
 
 from __future__ import annotations
@@ -37,6 +47,7 @@ import os
 import numpy as np
 
 from ..fields import bn254
+from ..ops.msm import _TableLRU, _record_event
 from .constraint_system import CircuitConfig
 from .domain import COSET_GEN, Domain
 from .expressions import all_expressions, referenced_keys
@@ -81,6 +92,30 @@ def _helpers():
         _jit_helpers["fold"] = jax.jit(
             lambda acc, y, e: F.add(fctx, F.mont_mul(fctx, acc, y[None, :]), e))
     return _jit_helpers
+
+
+def _scalar_budget_bytes() -> int:
+    mb = os.environ.get("SPECTRE_QUOTIENT_SCALAR_MB")
+    return (int(mb) if mb is not None else 4) << 20
+
+
+# Montgomery [16] device scalars keyed by field value — gate coefficients,
+# challenges, eval points. Previously a per-prove dict with a clear-at-4096
+# panic valve that threw the WHOLE working set away mid-prove; now the same
+# byte-budgeted LRU as the MSM/NTT tables (ISSUE 19): eviction is oldest-
+# first, counted, and a recompute after eviction is visible in stats()
+# (pinned by tests/test_quotient_sharded.py). ~64 bytes/entry — the default
+# 4 MB holds every scalar any real circuit has produced; the knob exists so
+# the bound is explicit, not so it's ever hit.
+_scalar_cache = _TableLRU(_scalar_budget_bytes(),
+                          label="quotient mont scalar",
+                          budget_var="SPECTRE_QUOTIENT_SCALAR_MB",
+                          on_event=_record_event)
+
+
+def scalar_lru_stats() -> dict:
+    """Quotient scalar-cache stats for GET /metrics."""
+    return _scalar_cache.stats()
 
 
 # columns per batched coset-LDE prefetch chunk: fixed so the [B, 4n, 16]
@@ -141,6 +176,96 @@ class _DeviceCtx:
 
         return jnp.broadcast_to(self._mont(s), (self._m, 16))
 
+    def fold(self, acc, y, e):
+        return self._h["fold"](acc, self._mont(y), e)
+
+
+class _LocalEngine:
+    """Single-device quotient engine: the original pipeline, expressed
+    through the same seam the mesh engine plugs into."""
+
+    name = "local"
+
+    def __init__(self, dom: Domain):
+        self.dom = dom
+        self.m = dom.n_ext
+
+    def chunk(self, base: int) -> int:
+        return base
+
+    def lde(self, std16: np.ndarray):
+        """Batched fused coset-LDE of a [B, m, 16] standard-form stack: ONE
+        compiled kernel (std→mont + g^i scale fused into stage 0;
+        SPECTRE_NTT_MODE selects radix2/fourstep)."""
+        import jax.numpy as jnp
+
+        from ..ops import ntt as NTT
+
+        out = NTT.coset_lde_std(jnp.asarray(std16), self.dom.omega_ext,
+                                COSET_GEN)
+        return [out[i] for i in range(std16.shape[0])]
+
+    def device_col(self, arr16):
+        return arr16
+
+    def ctx(self, cols, last_row: int, mont_scalar) -> _DeviceCtx:
+        return _DeviceCtx(cols, self.m, last_row, mont_scalar)
+
+    def inverse_std(self, acc, vinv_vals) -> np.ndarray:
+        from ..ops import ntt as NTT
+
+        if vinv_vals is None:
+            std = NTT.coset_intt_std(acc, self.dom.omega_ext, COSET_GEN)
+        else:
+            std = NTT.coset_intt_std_vinv(acc, self.dom.omega_ext,
+                                          COSET_GEN, vinv_vals)
+        return np.asarray(std)
+
+
+def _shard_min_logn() -> int:
+    """Extended domains below 2^this stay single-device without noise: at
+    small m the per-op collective + dispatch overhead swamps the shard win,
+    and a dev-box 8-virtual-device mesh would otherwise silently route every
+    ordinary test prove through the mesh runners on one physical core. The
+    default mirrors SHARD_NTT_MIN_LOGN (the quotient is NTT-dominated):
+    high enough that only an explicit opt-in (bench-quotient-multichip, the
+    sharded-quotient tests) engages the mesh on a virtual-device box."""
+    return int(os.environ.get("SPECTRE_SHARD_QUOTIENT_MIN_LOGN", "18"))
+
+
+def _degrade(reason: str, **detail):
+    from ..utils.health import HEALTH
+    HEALTH.incr("quotient_sharded_degraded")
+    _record_event("quotient_sharded_degraded", reason=reason, **detail)
+
+
+def _mesh_engine(dom: Domain):
+    """The sharded engine when the mesh prove path applies, else None.
+
+    Silent single-device: kill switch off, one device, or below the size
+    gate. VISIBLE degrade (`quotient_sharded_degraded` counter + provenance
+    event): a real mesh and a big enough domain, but a shape the Bailey
+    row partition can't cover."""
+    if os.environ.get("SPECTRE_QUOTIENT_SHARDED", "1") == "0":
+        return None
+    import jax
+    if jax.device_count() <= 1:
+        return None
+    logm = dom.n_ext.bit_length() - 1
+    if logm < _shard_min_logn():
+        return None
+    from ..parallel import sharded_quotient as SQ
+    from ..parallel.plan import current_plan
+
+    plan = current_plan()
+    if plan.n_devices <= 1:
+        return None
+    if not SQ.eligible(plan, dom.n_ext):
+        _degrade("ineligible_shape", n_ext=dom.n_ext,
+                 n_devices=plan.n_devices)
+        return None
+    return SQ.MeshQuotientEngine(plan, dom)
+
 
 def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
                      beta: int, gamma: int, y: int) -> np.ndarray:
@@ -149,6 +274,20 @@ def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
 
     fetch_coeffs(key) -> [<=n, 4] u64 coefficient-form poly for every column
     key the expression tree reads."""
+    engine = _mesh_engine(dom)
+    if engine is not None:
+        try:
+            return _quotient_impl(cfg, dom, fetch_coeffs, beta, gamma, y,
+                                  engine)
+        except Exception as e:  # mesh-path failure: fall back, visibly
+            _degrade("mesh_exception", error=f"{type(e).__name__}: {e}",
+                     n_ext=dom.n_ext)
+    return _quotient_impl(cfg, dom, fetch_coeffs, beta, gamma, y,
+                          _LocalEngine(dom))
+
+
+def _quotient_impl(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
+                   beta: int, gamma: int, y: int, engine) -> np.ndarray:
     import jax.numpy as jnp
 
     from ..ops import limbs as L16
@@ -159,17 +298,12 @@ def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
     mont_of = lambda ints: to_mont16(
         jnp.asarray(L16.u64limbs_to_u16limbs(B.to_arr(ints))))
 
-    _scalar_cache: dict = {}
-
     def mont_scalar(s):
         v = int(s) % R
-        if v not in _scalar_cache:
-            if len(_scalar_cache) > 4096:
-                _scalar_cache.clear()
-            _scalar_cache[v] = mont_of([v])[0]
-        return _scalar_cache[v]
-
-    from ..ops import ntt as NTT
+        hit = _scalar_cache.get(v, None)
+        if hit is None:
+            hit = _scalar_cache.put(v, None, mont_of([v])[0])
+        return hit
 
     # per-(cfg, domain) static device inputs: synthetic rows, x column —
     # built once, reused every proof (the coset scale / unscale tables now
@@ -199,18 +333,15 @@ def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
         _static_cache[ck] = st
 
     def ext_of_many(arrs_u64):
-        """Batched fused coset-LDE of a coefficient-array list: ONE
-        compiled [B, 4n, 16] kernel (std→mont + g^i scale fused into
-        stage 0; SPECTRE_NTT_MODE selects radix2/fourstep)."""
+        """Pack a coefficient-array list into ONE standard-form [B, m, 16]
+        stack and extend it through the engine's batched LDE."""
         b = len(arrs_u64)
         stack = np.zeros((b, m, 4), dtype=np.uint64)
         for i, cf in enumerate(arrs_u64):
             stack[i, :cf.shape[0]] = cf
         std16 = L16.u64limbs_to_u16limbs(stack.reshape(-1, 4)).reshape(
             b, m, 16)
-        out = NTT.coset_lde_std(jnp.asarray(std16), dom.omega_ext,
-                                COSET_GEN)
-        return [out[i] for i in range(b)]
+        return engine.lde(std16)
 
     def ext_of_coeffs(arr_u64):
         return ext_of_many([arr_u64])[0]
@@ -223,10 +354,10 @@ def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
         ("_l0",): l0_e,
         ("_llast",): llast_e,
         ("_lblind",): lblind_e,
-        ("_xcol",): st["xcol"],
+        ("_xcol",): engine.device_col(st["xcol"]),
     }
     plan = [k for k in referenced_keys(cfg) if k not in cols]
-    chunk_sz = _ext_chunk(m)
+    chunk_sz = engine.chunk(_ext_chunk(m))
     for base in range(0, len(plan), chunk_sz):
         chunk = plan[base:base + chunk_sz]
         # pad the tail chunk with the first key so the kernel sees one
@@ -243,11 +374,10 @@ def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
             self[key] = arr
             return arr
 
-    ctx = _DeviceCtx(LazyCols(cols), m, cfg.last_row, mont_scalar)
-    y_m = mont_scalar(y)
+    ctx = engine.ctx(LazyCols(cols), cfg.last_row, mont_scalar)
     acc = None
     for e in all_expressions(cfg, ctx, beta, gamma):
-        acc = e if acc is None else h["fold"](acc, y_m, e)
+        acc = e if acc is None else ctx.fold(acc, y, e)
     if acc is None:
         raise ValueError("config yields no constraint expressions — "
                          "nothing to fold into a quotient")
@@ -255,13 +385,12 @@ def compute_quotient(cfg: CircuitConfig, dom: Domain, fetch_coeffs,
     # the 1/Z_H stage-0 pre-scale, the iNTT, and the combined
     # g^{-i}·n^{-1}·(mont→std) output table all ride a single transform
     if _fused_vinv():
-        std = NTT.coset_intt_std_vinv(acc, dom.omega_ext, COSET_GEN,
-                                      dom.vanishing_inv_period_vals())
+        std = engine.inverse_std(acc, dom.vanishing_inv_period_vals())
     else:
         vinv = st.get("vinv")
         if vinv is None:
             vinv = st["vinv"] = to_mont16(jnp.asarray(
                 L16.u64limbs_to_u16limbs(dom.vanishing_inv_on_extended())))
-        hacc = h["mul"](acc, vinv)
-        std = NTT.coset_intt_std(hacc, dom.omega_ext, COSET_GEN)
+        hacc = ctx.mul(acc, engine.device_col(vinv))
+        std = engine.inverse_std(hacc, None)
     return L16.u16limbs_to_u64limbs(np.asarray(std))

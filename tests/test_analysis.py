@@ -471,7 +471,7 @@ class TestTraceLintDynamic:
         `make test` (no marker filter; lint-deep also drives the same
         probes there), stays out of the 870s tier-1 window."""
         from spectre_tpu.analysis.trace_lint import PROBES, run_probes
-        assert len(PROBES) == 6
+        assert len(PROBES) == 7
         t0 = time.monotonic()
         fs = run_probes()
         dt = time.monotonic() - t0
@@ -573,6 +573,15 @@ class TestShippedBaseline:
         ctx, cfg, name = AUDIT_CIRCUITS["committee_update"]()
         assert AR(ctx, cfg, name) == []
 
+    def test_matmul_cap_proof_needs_no_baseline(self):
+        """ISSUE 19: the closed-form exactness proof of the shipped
+        `_MATMUL_MAX_LOGN` (two-level carry split + 2^272 REDC) holds
+        against the EMPTY baseline — the cap is proven, not asserted."""
+        from spectre_tpu.analysis.kernel_lint import lint_matmul_cap
+        from spectre_tpu.ops.ntt import _MATMUL_MAX_LOGN
+        assert _MATMUL_MAX_LOGN >= 12
+        assert lint_matmul_cap() == []
+
 
 class TestBenchFloorGuard:
     """ISSUE 17 satellite: the Pallas MSM path must never regress the
@@ -586,6 +595,8 @@ class TestBenchFloorGuard:
         "bn254_ntt_2^12_cpu_polys_per_s": 7.5,
         "bn254_msm_2^12_multichip8_points_per_s": 79,
         "gateway_serve_requests_per_s": 25000,
+        "quotient_k11_cpu_per_s": 0.2,
+        "quotient_k13_multichip8_per_s": 0.04,
     }
 
     def test_xla_floors_unchanged(self):

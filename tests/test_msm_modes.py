@@ -260,6 +260,27 @@ class TestDefaultWindowTuning:
             assert MSM.default_window_fixed(n) == \
                 MSM.default_window(n, signed=True)
 
+    def test_pinned_pallas(self):
+        # pallas buckets are VMEM-resident: 254-bit vanilla scalars double
+        # nwin vs GLV, so the 2^18 class drops 13 -> 11 (~4.5 MB resident
+        # vs ~15 MB); the 126-bit signed paths fit their XLA widths.
+        assert [MSM.default_window_pallas(n) for n in
+                (1 << 6, 1 << 7, 1 << 12, 1 << 18)] == [4, 7, 10, 11]
+        assert [MSM.default_window_pallas(n, signed=True) for n in
+                (1 << 6, 1 << 7, 1 << 12, 1 << 18)] == [5, 8, 11, 13]
+        # every pallas width actually fits the budget
+        for signed, nbits in ((False, 254), (True, 126)):
+            for n in (1 << 6, 1 << 12, 1 << 18):
+                c = MSM.default_window_pallas(n, signed=signed)
+                assert MSM._pallas_bucket_bytes(c, nbits) <= \
+                    MSM._PALLAS_BUCKET_VMEM_BUDGET
+
+    def test_pallas_override_wins(self, monkeypatch):
+        monkeypatch.setenv("SPECTRE_MSM_WINDOW", "12")
+        # the sweep knob must reach the pallas dispatch too, even past the
+        # VMEM table (a real-hardware sweep needs to probe beyond the cap)
+        assert MSM.default_window_pallas(1 << 18) == 12
+
 
 class TestWindowOverride:
     """SPECTRE_MSM_WINDOW: one env knob retunes every MSM path (the value
